@@ -1,0 +1,11 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""nds-tpu: a TPU-native decision-support (TPC-DS derived) benchmark framework.
+
+Rebuilds the capabilities of the NDS v2.0 harness (spark-rapids-benchmarks)
+on a JAX/XLA/Pallas stack: columnar execution on TPU HBM, pjit/shard_map
+partitioning over a device mesh, and ICI all-to-all exchange in place of the
+network shuffle. See SURVEY.md at the repo root for the structural map of the
+reference this build follows.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,81 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Runtime failure listener: the TPU-native stand-in for the reference's
+Scala SparkListener + Py4J bridge (ref: nds/jvm_listener/src/main/scala/com/
+nvidia/spark/rapids/listener/TaskFailureListener.scala:27-36 and
+nds/python_listener/PythonListener.py:21-61).
+
+The reference registers an in-JVM listener that captures every non-Success
+task end reason and fans it out to Python callbacks. Here the execution
+engine is in-process, so the bridge collapses to a process-local registry:
+the engine's partition executor reports every retried/failed partition task
+and every device runtime error (XLA/PJRT) to all registered listeners, which
+feed the ``CompletedWithTaskFailures`` status taxonomy in
+:mod:`nds_tpu.report`.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TaskFailure:
+    """One failed/retried unit of work inside an otherwise-running query."""
+
+    where: str        # e.g. "partition 3/8 of hash_join probe"
+    reason: str       # exception text / device error
+    fatal: bool = False
+
+
+class FailureListener:
+    """Accumulates task-failure reasons for one query run
+    (ref: nds/python_listener/PythonListener.py:30-49)."""
+
+    def __init__(self):
+        self.failures: list[TaskFailure] = []
+        self._lock = threading.Lock()
+
+    def notify(self, where: str, reason: str, fatal: bool = False) -> None:
+        with self._lock:
+            self.failures.append(TaskFailure(where, reason, fatal))
+
+    def register(self) -> "FailureListener":
+        Manager.register(self)
+        return self
+
+    def unregister(self) -> None:
+        Manager.unregister(self)
+
+
+class Manager:
+    """Process-wide fan-out registry (ref: nds/jvm_listener/.../Manager.scala:24-63)."""
+
+    _listeners: list[FailureListener] = []
+    _lock = threading.Lock()
+
+    @classmethod
+    def register(cls, listener: FailureListener) -> None:
+        with cls._lock:
+            if listener not in cls._listeners:
+                cls._listeners.append(listener)
+
+    @classmethod
+    def unregister(cls, listener: FailureListener) -> None:
+        with cls._lock:
+            if listener in cls._listeners:
+                cls._listeners.remove(listener)
+
+    @classmethod
+    def notify_all(cls, where: str, reason: str, fatal: bool = False) -> None:
+        with cls._lock:
+            listeners = list(cls._listeners)
+        for l in listeners:
+            l.notify(where, reason, fatal)
+
+
+def report_task_failure(where: str, exc: BaseException, fatal: bool = False) -> None:
+    """Engine-side hook: call on any retried partition task or device error."""
+    reason = "".join(traceback.format_exception_only(type(exc), exc)).strip()
+    Manager.notify_all(where, reason, fatal)

@@ -1,0 +1,57 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""BenchReport status taxonomy + summary file contract tests
+(ref: nds/PysparkBenchReport.py:60-127)."""
+
+import glob
+import json
+import os
+
+from nds_tpu.listener import Manager, report_task_failure
+from nds_tpu.report import BenchReport
+
+
+def test_completed_status_and_timing():
+    r = BenchReport()
+    ms = r.report_on(lambda: sum(range(1000)))
+    assert r.summary["queryStatus"] == ["Completed"]
+    assert r.is_success()
+    assert ms >= 0 and r.summary["queryTimes"] == [ms]
+
+
+def test_failed_status_captures_exception():
+    r = BenchReport()
+    def boom():
+        raise ValueError("query exploded")
+    r.report_on(boom)
+    assert r.summary["queryStatus"] == ["Failed"]
+    assert not r.is_success()
+    assert "query exploded" in r.summary["exceptions"][0]
+
+
+def test_task_failure_status():
+    """A run that completes but saw retried tasks is distinguishable
+    (ref: nds/PysparkBenchReport.py:90-93)."""
+    r = BenchReport()
+    def work_with_retry():
+        report_task_failure("partition 3/8 probe", RuntimeError("device OOM, retried"))
+    r.report_on(work_with_retry)
+    assert r.summary["queryStatus"] == ["CompletedWithTaskFailures"]
+    assert r.is_success()  # task failures are not a query failure
+    assert "device OOM" in r.summary["exceptions"][0]
+    assert not Manager._listeners  # unregistered after run
+
+
+def test_summary_filename_contract(tmp_path, monkeypatch):
+    """<prefix>-<query>-<startTime>.json (ref: nds/PysparkBenchReport.py:118-119)."""
+    monkeypatch.setenv("MY_API_TOKEN", "hunter2")
+    r = BenchReport()
+    r.report_on(lambda: None)
+    prefix = str(tmp_path / "sub" / "run1")
+    r.write_summary("query96", prefix)
+    files = glob.glob(str(tmp_path / "sub" / "run1-query96-*.json"))
+    assert len(files) == 1
+    start_time = os.path.basename(files[0]).split("-")[-1][:-5]
+    assert start_time == str(r.summary["startTime"])
+    data = json.load(open(files[0]))
+    assert data["query"] == "query96"
+    assert data["env"]["envVars"]["MY_API_TOKEN"] == "*******"

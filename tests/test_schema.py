@@ -1,0 +1,98 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Schema contract tests: table/column counts and type lowering match the
+reference contract (ref: nds/nds_schema.py:49-716)."""
+
+import pyarrow as pa
+
+from nds_tpu import types
+from nds_tpu.schema import (
+    MAINTENANCE_TABLE_NAMES,
+    SOURCE_TABLE_NAMES,
+    get_maintenance_schemas,
+    get_schemas,
+)
+
+# (table, n_columns) spot checks against the reference schema definitions.
+EXPECTED_WIDTHS = {
+    "customer_address": 13,
+    "customer_demographics": 9,
+    "date_dim": 28,
+    "warehouse": 14,
+    "ship_mode": 6,
+    "time_dim": 10,
+    "reason": 3,
+    "income_band": 3,
+    "item": 22,
+    "store": 29,
+    "call_center": 31,
+    "customer": 18,
+    "web_site": 26,
+    "store_returns": 20,
+    "household_demographics": 5,
+    "web_page": 14,
+    "promotion": 19,
+    "catalog_page": 9,
+    "inventory": 4,
+    "catalog_returns": 27,
+    "web_returns": 24,
+    "web_sales": 34,
+    "catalog_sales": 34,
+    "store_sales": 23,
+}
+
+
+def test_source_table_inventory():
+    schemas = get_schemas(use_decimal=True)
+    assert len(schemas) == 24
+    assert set(schemas) == set(SOURCE_TABLE_NAMES)
+    for name, width in EXPECTED_WIDTHS.items():
+        assert len(schemas[name]) == width, name
+
+
+def test_maintenance_table_inventory():
+    schemas = get_maintenance_schemas(use_decimal=True)
+    assert len(schemas) == 12
+    assert set(schemas) == set(MAINTENANCE_TABLE_NAMES)
+    # the refresh stream tables LF_*.sql joins against
+    for t in ("s_purchase", "s_purchase_lineitem", "s_catalog_order",
+              "s_web_order", "s_inventory", "delete", "inventory_delete"):
+        assert t in schemas
+
+
+def test_long_identifiers():
+    """Large-scale ticket/catalog numbers are 64-bit (ref: nds/nds_schema.py:331,553)."""
+    s = get_schemas(use_decimal=True)
+    by = {t: {f.name: f for f in fields} for t, fields in s.items()}
+    assert by["store_sales"]["ss_ticket_number"].type == "int64"
+    assert by["store_returns"]["sr_ticket_number"].type == "int64"
+    assert by["catalog_page"]["cp_catalog_number"].type == "int64"
+    # order numbers stay 32-bit as in the reference
+    assert by["catalog_sales"]["cs_order_number"].type == "int32"
+
+
+def test_decimal_toggle():
+    """use_decimal=False lowers decimals to float64 (ref: nds/nds_schema.py:43-47)."""
+    dec = get_schemas(use_decimal=True)
+    flt = get_schemas(use_decimal=False)
+    f_dec = {f.name: f for f in dec["store_sales"]}
+    f_flt = {f.name: f for f in flt["store_sales"]}
+    assert f_dec["ss_list_price"].type == "decimal(7,2)"
+    assert f_flt["ss_list_price"].type == "double"
+    assert f_dec["ss_quantity"].type == f_flt["ss_quantity"].type == "int64"
+
+
+def test_arrow_lowering():
+    assert types.to_arrow("decimal(7,2)") == pa.decimal128(7, 2)
+    assert types.to_arrow("char(16)") == pa.string()
+    assert types.to_arrow("date") == pa.date32()
+    assert types.to_arrow("int64") == pa.int64()
+    for t, fields in get_schemas(True).items():
+        for f in fields:
+            types.to_arrow(f.type)  # must not raise
+            types.device_kind(f.type)
+
+
+def test_device_kinds():
+    assert types.device_kind("decimal(7,2)") == "dec(7,2)"
+    assert types.device_kind("varchar(60)") == "str"
+    assert types.device_kind("date") == "date"
