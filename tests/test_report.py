@@ -36,7 +36,9 @@ def test_task_failure_status():
         report_task_failure("partition 3/8 probe", RuntimeError("device OOM, retried"))
     r.report_on(work_with_retry)
     assert r.summary["queryStatus"] == ["CompletedWithTaskFailures"]
-    assert r.is_success()  # task failures are not a query failure
+    # the reference exit gate treats task failures as NOT a success
+    # (ref: nds/nds_power.py:310-322)
+    assert not r.is_success()
     assert "device OOM" in r.summary["exceptions"][0]
     assert not Manager._listeners  # unregistered after run
 
