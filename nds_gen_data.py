@@ -1,0 +1,224 @@
+#!/usr/bin/env python3
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Raw data generation driver.
+
+TPU-build equivalent of the reference data-gen CLI (ref: nds/nds_gen_data.py):
+drives the native generator (`native/ndsgen/ndsgen`, or a user-supplied patched
+TPC-DS dsdgen via $TPCDS_HOME) in parallel chunks, then lands per-table flat
+files into per-table subdirectories. Supports incremental generation via
+``--range`` with a temp-dir merge (ref: nds/nds_gen_data.py:91-117,155-174) and
+refresh-data generation via ``--update`` (ref: nds/nds_gen_data.py:119-127).
+
+Modes:
+  local  - fan out chunk processes on this host (ref: generate_data_local,
+           nds/nds_gen_data.py:183-244)
+  dist   - fan out chunk ranges across pod hosts over ssh (the role the
+           Hadoop MR wrapper GenTable.java plays in the reference); hosts come
+           from --hosts or $NDS_HOSTS (comma-separated). Falls back to local
+           when no host list is given.
+"""
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from nds_tpu.check import (  # noqa: E402
+    check_build_ndsgen,
+    check_version,
+    get_abs_path,
+    get_dir_size,
+    parallel_value,
+    valid_range,
+)
+from nds_tpu.schema import MAINTENANCE_TABLE_NAMES, SOURCE_TABLE_NAMES  # noqa: E402
+
+check_version()
+
+
+def _tool_cmd(tool_path, args, child):
+    """Build one chunk command line for whichever generator is installed."""
+    if tool_path.name == "dsdgen":
+        # spec toolkit surface (ref: nds/nds_gen_data.py:211-220)
+        cmd = ["./dsdgen", "-scale", args.scale, "-dir", args._out_dir,
+               "-parallel", str(args.parallel), "-child", str(child), "-verbose", "Y"]
+        if args.overwrite_output:
+            cmd += ["-force", "Y"]
+        if args.update:
+            cmd += ["-update", args.update]
+        return cmd, str(tool_path.parent)
+    cmd = [str(tool_path), "-scale", args.scale, "-dir", args._out_dir,
+           "-parallel", str(args.parallel), "-child", str(child)]
+    if args.update:
+        cmd += ["-update", args.update]
+    if args.rngseed:
+        cmd += ["-rngseed", args.rngseed]
+    return cmd, None
+
+
+def _table_names(args):
+    return list(MAINTENANCE_TABLE_NAMES) if args.update else list(SOURCE_TABLE_NAMES)
+
+
+def _move_into_table_dirs(data_dir, parallel, range_start, range_end, tables):
+    """Land flat chunk files in per-table subdirectories
+    (ref: nds/nds_gen_data.py:229-243)."""
+    for table in tables:
+        tdir = os.path.join(data_dir, table)
+        os.makedirs(tdir, exist_ok=True)
+        candidates = [f"{table}.dat", f"{table}_1.dat"]
+        candidates += [f"{table}_{i}_{parallel}.dat" for i in range(range_start, range_end + 1)]
+        for fname in candidates:
+            src = os.path.join(data_dir, fname)
+            if os.path.exists(src):
+                shutil.move(src, os.path.join(tdir, fname))
+
+
+def move_delete_date_tables(data_dir, update):
+    """delete_<n>.dat / inventory_delete_<n>.dat land in their own dirs
+    (ref: nds/nds_gen_data.py:119-127)."""
+    for table in ("delete", "inventory_delete"):
+        tdir = os.path.join(data_dir, table)
+        os.makedirs(tdir, exist_ok=True)
+        fname = f"{table}_{update}.dat"
+        src = os.path.join(data_dir, fname)
+        if os.path.exists(src):
+            shutil.move(src, os.path.join(tdir, fname))
+
+
+def merge_temp_tables(temp_dir, data_dir, tables):
+    """Merge an incremental --range generation out of the temp dir into the
+    final location (ref: nds/nds_gen_data.py:91-117)."""
+    for table in tables:
+        src_dir = os.path.join(temp_dir, table)
+        if not os.path.isdir(src_dir):
+            continue
+        dst_dir = os.path.join(data_dir, table)
+        os.makedirs(dst_dir, exist_ok=True)
+        for f in os.listdir(src_dir):
+            shutil.move(os.path.join(src_dir, f), os.path.join(dst_dir, f))
+    shutil.rmtree(temp_dir, ignore_errors=True)
+
+
+def _run_chunks(args, tool_path, range_start, range_end):
+    procs = []
+    for child in range(range_start, range_end + 1):
+        cmd, cwd = _tool_cmd(tool_path, args, child)
+        procs.append(subprocess.Popen(cmd, cwd=cwd))
+    failed = [p for p in procs if p.wait() != 0]
+    if failed:
+        raise RuntimeError(f"{len(failed)} generator chunk(s) failed")
+
+
+def _split_ranges(lo, hi, n):
+    """Split inclusive child range [lo, hi] into n contiguous sub-ranges."""
+    total = hi - lo + 1
+    out = []
+    start = lo
+    for i in range(n):
+        size = total // n + (1 if i < total % n else 0)
+        if size == 0:
+            continue
+        out.append((start, start + size - 1))
+        start += size
+    return out
+
+
+def generate_data_dist(args, tool_path, range_start, range_end):
+    """Distributed generation: one ssh subprocess per pod host, each covering
+    a contiguous child sub-range and writing to the shared data_dir. This is
+    the framework's stand-in for the reference's one-command-per-mapper MR job
+    (ref: nds/tpcds-gen/src/main/java/org/notmysock/tpcds/GenTable.java:188-209)."""
+    hosts = args.hosts or os.environ.get("NDS_HOSTS", "")
+    host_list = [h.strip() for h in hosts.split(",") if h.strip()]
+    if not host_list:
+        print("no host list for dist mode; running locally")
+        return generate_data_local(args, tool_path, range_start, range_end)
+    data_dir = _prepare_out_dir(args)
+    procs = []
+    for host, (lo, hi) in zip(host_list, _split_ranges(range_start, range_end, len(host_list))):
+        sub = [sys.executable, os.path.abspath(__file__), "local", args.scale,
+               str(args.parallel), get_abs_path(args.data_dir),
+               "--range", f"{lo},{hi}"]
+        if args.update:
+            sub += ["--update", args.update]
+        if args.overwrite_output:
+            sub += ["--overwrite_output"]
+        if args.rngseed:
+            sub += ["--rngseed", args.rngseed]
+        procs.append(subprocess.Popen(["ssh", host] + sub))
+    failed = [p for p in procs if p.wait() != 0]
+    if failed:
+        raise RuntimeError(f"{len(failed)} host(s) failed during distributed generation")
+    print(f"distributed generation complete across {len(host_list)} hosts -> {data_dir}")
+
+
+def _prepare_out_dir(args):
+    data_dir = get_abs_path(args.data_dir)
+    if not os.path.isdir(data_dir):
+        os.makedirs(data_dir)
+    elif get_dir_size(data_dir) > 0 and not args.overwrite_output and not args.range \
+            and not args.update:
+        raise RuntimeError(
+            f"There's already data in {data_dir}. Use --overwrite_output to overwrite.")
+    return data_dir
+
+
+def generate_data_local(args, tool_path, range_start, range_end):
+    data_dir = _prepare_out_dir(args)
+    tables = _table_names(args)
+    if args.range:
+        # incremental generation goes through a per-range temp dir then
+        # merges; the range suffix keeps concurrent hosts from clobbering each
+        # other's in-flight chunks (ref: nds/nds_gen_data.py:155-174)
+        temp_dir = os.path.join(data_dir, f"_temp_{range_start}_{range_end}")
+        shutil.rmtree(temp_dir, ignore_errors=True)
+        os.makedirs(temp_dir)
+        args._out_dir = temp_dir
+        _run_chunks(args, tool_path, range_start, range_end)
+        _move_into_table_dirs(temp_dir, args.parallel, range_start, range_end, tables)
+        if args.update:
+            move_delete_date_tables(temp_dir, args.update)
+        merge_temp_tables(temp_dir, data_dir, tables)
+    else:
+        args._out_dir = data_dir
+        _run_chunks(args, tool_path, range_start, range_end)
+        _move_into_table_dirs(data_dir, args.parallel, range_start, range_end, tables)
+        if args.update:
+            move_delete_date_tables(data_dir, args.update)
+    subprocess.run(["du", "-h", "-d1", data_dir], check=False)
+
+
+def generate_data(args):
+    tool_path = check_build_ndsgen()
+    range_start, range_end = 1, int(args.parallel)
+    if args.range:
+        range_start, range_end = valid_range(args.range, args.parallel)
+    if args.type == "dist":
+        generate_data_dist(args, tool_path, range_start, range_end)
+    else:
+        generate_data_local(args, tool_path, range_start, range_end)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("type", choices=["local", "dist"],
+                        help="where to run generation: this host, or across pod hosts")
+    parser.add_argument("scale", help="volume of data to generate in GB")
+    parser.add_argument("parallel", type=parallel_value,
+                        help="build data in <parallel_value> separate chunks")
+    parser.add_argument("data_dir", help="generate data in directory")
+    parser.add_argument("--range",
+                        help="incremental generation: which child chunks to build in this "
+                             "run, format 'start,end' inclusive within --parallel")
+    parser.add_argument("--overwrite_output", action="store_true",
+                        help="overwrite existing data in the output path")
+    parser.add_argument("--update",
+                        help="generate refresh dataset <n> for the Data Maintenance tests")
+    parser.add_argument("--hosts", help="comma-separated pod host list for dist mode")
+    parser.add_argument("--rngseed", help="random seed for the native generator")
+    args = parser.parse_args()
+    generate_data(args)

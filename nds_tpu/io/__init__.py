@@ -1,0 +1,5 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""IO layer: raw '|'-delimited CSV ingest and columnar (Parquet/ORC) output."""
+
+from nds_tpu.io.csv import read_raw_table  # noqa: F401
+from nds_tpu.io.columnar import read_table, write_table  # noqa: F401

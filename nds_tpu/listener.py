@@ -50,9 +50,18 @@ class FailureListener:
 
 
 class Manager:
-    """Process-wide fan-out registry (ref: nds/jvm_listener/.../Manager.scala:24-63)."""
+    """Fan-out registry (ref: nds/jvm_listener/.../Manager.scala:24-63).
 
-    _listeners: list[FailureListener] = []
+    Listeners are scoped to the thread that registered them: concurrent
+    in-process query streams (Throughput Run) each see only their own task
+    failures. Failures raised from a thread with no scoped listener (e.g. a
+    shared device-runtime callback thread) fan out to every listener, since
+    they cannot be attributed to one stream. Engine partition workers report
+    through their owning query's listener explicitly (executor carries it).
+    """
+
+    _listeners: list[FailureListener] = []       # (owner_thread_id, listener) pairs
+    _owners: list[int] = []
     _lock = threading.Lock()
 
     @classmethod
@@ -60,18 +69,23 @@ class Manager:
         with cls._lock:
             if listener not in cls._listeners:
                 cls._listeners.append(listener)
+                cls._owners.append(threading.get_ident())
 
     @classmethod
     def unregister(cls, listener: FailureListener) -> None:
         with cls._lock:
             if listener in cls._listeners:
-                cls._listeners.remove(listener)
+                i = cls._listeners.index(listener)
+                cls._listeners.pop(i)
+                cls._owners.pop(i)
 
     @classmethod
     def notify_all(cls, where: str, reason: str, fatal: bool = False) -> None:
+        me = threading.get_ident()
         with cls._lock:
-            listeners = list(cls._listeners)
-        for l in listeners:
+            scoped = [l for l, o in zip(cls._listeners, cls._owners) if o == me]
+            targets = scoped if scoped else list(cls._listeners)
+        for l in targets:
             l.notify(where, reason, fatal)
 
 
