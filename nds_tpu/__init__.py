@@ -9,3 +9,9 @@ reference this build follows.
 """
 
 __version__ = "0.1.0"
+
+# The engine's exact-decimal path is int64 fixed point and date arithmetic is
+# 64-bit; x64 must be on before any jax array is created.
+import jax as _jax  # noqa: E402
+
+_jax.config.update("jax_enable_x64", True)

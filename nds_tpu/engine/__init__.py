@@ -1,0 +1,14 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Columnar execution engine: the TPU-native replacement for the role the
+RAPIDS SQL plugin plays in the reference stack (SURVEY.md §2.2 N4).
+
+Tables live on device as JAX arrays — one array per column plus a validity
+mask; strings are dictionary-encoded (int32 codes on device, values on host);
+decimals are int64 scaled fixed point (exact arithmetic on the integer path);
+dates are int32 days-since-epoch. Relational operators (filter, project,
+hash/sort aggregate, join, sort, window) are built from XLA-friendly
+primitives: lexsort, segment reductions, searchsorted probes, gathers.
+"""
+
+from nds_tpu.engine.column import Column, from_arrow, to_arrow  # noqa: F401
+from nds_tpu.engine.table import DeviceTable  # noqa: F401

@@ -1,0 +1,234 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Device column representation and arrow <-> device conversion.
+
+Device kinds (see :func:`nds_tpu.types.device_kind`):
+
+    i32 / i64   plain integers                  -> int32 / int64 arrays
+    f64         doubles                         -> float64 arrays
+    date        calendar dates                  -> int32 days-since-epoch
+    dec(P,S)    decimals                        -> int64 scaled by 10**S
+    str         char/varchar/string             -> int32 dictionary codes +
+                                                   host-side value table
+    bool        intermediate predicates         -> bool arrays
+
+Null handling: every column optionally carries a ``valid`` bool mask; ``None``
+means all-valid. Data under invalid slots is zeroed so reductions can run
+unmasked where the zero is the identity.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, replace
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+_DEC_KIND_RE = re.compile(r"dec\((\d+),(\d+)\)")
+
+
+def dec_scale(kind: str) -> int:
+    m = _DEC_KIND_RE.match(kind)
+    if not m:
+        raise ValueError(f"not a decimal kind: {kind}")
+    return int(m.group(2))
+
+
+def dec_precision(kind: str) -> int:
+    return int(_DEC_KIND_RE.match(kind).group(1))
+
+
+def is_dec(kind: str) -> bool:
+    return kind.startswith("dec(")
+
+
+@dataclass
+class Column:
+    kind: str
+    data: jnp.ndarray
+    valid: jnp.ndarray | None = None          # bool mask; None = all valid
+    dict_values: np.ndarray | None = None     # host-side strings for kind 'str'
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def scale(self) -> int:
+        return dec_scale(self.kind) if is_dec(self.kind) else 0
+
+    def valid_mask(self) -> jnp.ndarray:
+        """Materialized validity mask."""
+        if self.valid is None:
+            return jnp.ones(self.data.shape[0], dtype=bool)
+        return self.valid
+
+    def null_count(self) -> int:
+        if self.valid is None:
+            return 0
+        return int(jnp.sum(~self.valid))
+
+    def take(self, indices) -> "Column":
+        return replace(
+            self,
+            data=jnp.take(self.data, indices, axis=0),
+            valid=None if self.valid is None else jnp.take(self.valid, indices, axis=0),
+        )
+
+    def with_valid(self, valid) -> "Column":
+        """Attach a (possibly combined) validity mask, zeroing masked slots."""
+        if valid is None:
+            return self
+        data = jnp.where(valid, self.data, jnp.zeros((), dtype=self.data.dtype))
+        return replace(self, data=data, valid=valid)
+
+
+# ---------------------------------------------------------------------------
+# arrow -> device
+# ---------------------------------------------------------------------------
+
+_NUMERIC_DTYPES = {
+    "i32": jnp.int32,
+    "i64": jnp.int64,
+    "f64": jnp.float64,
+    "date": jnp.int32,
+    "bool": jnp.bool_,
+}
+
+
+def _decimal_to_int64(arr: pa.ChunkedArray, s: int, target_scale: int) -> np.ndarray:
+    """decimal128(p, s) -> int64 of value * 10**target_scale, exactly.
+
+    Reads the unscaled int128 values straight out of the arrow buffer (low
+    word is the exact value while it fits in int64, which every schema decimal
+    does) and rescales in integer arithmetic.
+    """
+    out = np.empty(len(arr), dtype=np.int64)
+    pos = 0
+    for chunk in arr.chunks:
+        n = len(chunk)
+        buf = chunk.buffers()[1]
+        raw = np.frombuffer(buf, dtype="<i8")
+        lo = raw[2 * chunk.offset: 2 * (chunk.offset + n): 2]
+        out[pos:pos + n] = lo
+        pos += n
+    if target_scale > s:
+        out = out * (10 ** (target_scale - s))
+    elif target_scale < s:
+        out = out // (10 ** (s - target_scale))
+    return out
+
+
+def from_arrow_array(arr, canonical_type: str) -> Column:
+    """One arrow column (Array or ChunkedArray) -> device Column."""
+    from nds_tpu import types as _t
+
+    if isinstance(arr, pa.Array):
+        arr = pa.chunked_array([arr])
+    kind = _t.device_kind(canonical_type)
+    n = len(arr)
+    null_count = arr.null_count
+    valid_np = None
+    if null_count:
+        valid_np = ~np.asarray(pc.is_null(arr).combine_chunks().to_numpy(zero_copy_only=False))
+
+    if kind == "str":
+        if not pa.types.is_dictionary(arr.type):
+            arr = pc.dictionary_encode(arr)
+        combined = arr.combine_chunks()
+        if isinstance(combined, pa.ChunkedArray):
+            combined = combined.chunk(0) if combined.num_chunks else pa.array(
+                [], type=combined.type)
+        codes_arr = combined.indices
+        if null_count:
+            codes_arr = pc.fill_null(codes_arr, 0)
+        codes = np.asarray(codes_arr.to_numpy(zero_copy_only=False), dtype=np.int32)
+        values = np.asarray(combined.dictionary.to_pylist(), dtype=object)
+        if values.size == 0:
+            values = np.asarray([""], dtype=object)
+            codes = np.zeros(n, dtype=np.int32)
+        col = Column("str", jnp.asarray(codes),
+                     None if valid_np is None else jnp.asarray(valid_np), values)
+        return col
+
+    if kind.startswith("dec("):
+        s = dec_scale(kind)
+        if pa.types.is_decimal(arr.type):
+            filled = pc.fill_null(arr, pa.scalar(0, arr.type)) if null_count else arr
+            data_np = _decimal_to_int64(filled, arr.type.scale, s)
+        else:  # e.g. float column being treated as decimal
+            data_np = np.asarray(pc.fill_null(arr, 0).combine_chunks().to_numpy(
+                zero_copy_only=False))
+            data_np = np.round(data_np * (10 ** s)).astype(np.int64)
+        return Column(kind, jnp.asarray(data_np),
+                      None if valid_np is None else jnp.asarray(valid_np))
+
+    # plain numeric / date / bool
+    if kind == "date":
+        arr = pc.cast(arr, pa.int32())
+    filled = pc.fill_null(arr, 0) if null_count else arr
+    np_arr = np.asarray(filled.combine_chunks().to_numpy(zero_copy_only=False))
+    data = jnp.asarray(np_arr.astype(_NUMERIC_DTYPES[kind]))
+    return Column(kind, data, None if valid_np is None else jnp.asarray(valid_np))
+
+
+def from_arrow(table: pa.Table, canonical_types: dict | None = None):
+    """arrow Table -> {name: Column}. ``canonical_types`` overrides the
+    per-column canonical type (defaults to inference from arrow types)."""
+    from nds_tpu import types as _t
+    from nds_tpu.engine.table import DeviceTable
+
+    cols = {}
+    for name in table.column_names:
+        ct = (canonical_types or {}).get(name) or _t.arrow_to_canonical(
+            table.schema.field(name).type)
+        cols[name] = from_arrow_array(table[name], ct)
+    return DeviceTable(cols, table.num_rows)
+
+
+# ---------------------------------------------------------------------------
+# device -> arrow
+# ---------------------------------------------------------------------------
+
+def column_to_arrow(col: Column) -> pa.Array:
+    valid_np = None if col.valid is None else np.asarray(col.valid)
+
+    if col.kind == "str":
+        codes = np.asarray(col.data)
+        out = col.dict_values[codes]
+        mask = None if valid_np is None else ~valid_np
+        return pa.array(out, type=pa.string(), mask=mask)
+
+    data_np = np.asarray(col.data)
+    mask = None if valid_np is None else ~valid_np
+    if col.kind == "date":
+        return pa.array(data_np.astype("int32"), type=pa.int32(), mask=mask).cast(pa.date32())
+    if is_dec(col.kind):
+        s = dec_scale(col.kind)
+        # reinterpret the int64 fixed-point values as decimal128(38, s) by
+        # building the 128-bit little-endian buffer directly (a cast would
+        # multiply by 10**s instead of reinterpreting)
+        lo = data_np.astype(np.int64)
+        n = lo.shape[0]
+        buf = np.empty((n, 2), dtype=np.int64)
+        buf[:, 0] = lo
+        buf[:, 1] = np.where(lo < 0, -1, 0)
+        arr = pa.Array.from_buffers(
+            pa.decimal128(38, s), n, [None, pa.py_buffer(buf.tobytes())])
+        if valid_np is not None:
+            arr = pc.if_else(pa.array(valid_np), arr, pa.scalar(None, arr.type))
+        return arr
+    pa_type = {
+        "i32": pa.int32(), "i64": pa.int64(), "f64": pa.float64(), "bool": pa.bool_(),
+    }[col.kind]
+    return pa.array(data_np, type=pa_type, mask=mask)
+
+
+def to_arrow(dt) -> pa.Table:
+    """DeviceTable -> arrow Table."""
+    arrays, names = [], []
+    for name, col in dt.columns.items():
+        names.append(name)
+        arrays.append(column_to_arrow(col))
+    return pa.table(arrays, names=names)

@@ -1,0 +1,445 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Structural relational operators over DeviceTable.
+
+The TPU-native analogs of the physical operators the reference delegates to
+Spark+RAPIDS (Parquet scan, filter/project, hash join, hash aggregate, sort,
+window; SURVEY.md §2.2 N4). All grouping and joining is sort-based on device:
+lexsort + run boundaries + segment reductions — collision-free and
+XLA-friendly (fixed dtypes, gathers, segment ops), with searchsorted probes
+for the join build/probe phases.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nds_tpu.engine.column import Column, is_dec
+from nds_tpu.engine.table import DeviceTable
+
+# ---------------------------------------------------------------------------
+# sort-key preparation
+# ---------------------------------------------------------------------------
+
+
+def ordered_codes(col: Column) -> jnp.ndarray:
+    """For a string column, map dictionary codes to lexicographic ranks so
+    integer comparisons order like string comparisons."""
+    order = np.argsort(col.dict_values.astype(str), kind="stable")
+    ranks = np.empty(len(order), dtype=np.int64)
+    ranks[order] = np.arange(len(order))
+    return jnp.take(jnp.asarray(ranks), col.data)
+
+
+def sortable_view(col: Column) -> jnp.ndarray:
+    """Numeric view of a column that sorts in SQL ascending order."""
+    if col.kind == "str":
+        return ordered_codes(col)
+    if col.kind == "bool":
+        return col.data.astype(jnp.int32)
+    return col.data
+
+
+def lexsort_indices(cols, descending=None, nulls_last=None) -> jnp.ndarray:
+    """Stable multi-key sort. ``cols`` primary-first; per-key descending and
+    nulls-last flags (SQL default: asc, nulls first — Spark semantics)."""
+    n = len(cols[0])
+    if descending is None:
+        descending = [False] * len(cols)
+    if nulls_last is None:
+        nulls_last = [False] * len(cols)
+    keys = []  # build primary-first, then reverse for lexsort
+    for col, desc, nl in zip(cols, descending, nulls_last):
+        v = sortable_view(col).astype(jnp.int64) if col.kind != "f64" else sortable_view(col)
+        if desc:
+            v = -v
+        null_rank_when_null = 1 if nl else -1
+        if col.valid is not None:
+            nullk = jnp.where(col.valid, 0, null_rank_when_null)
+            # zero the value under nulls so the value tiebreak is stable
+            v = jnp.where(col.valid, v, jnp.zeros((), dtype=v.dtype))
+        else:
+            nullk = jnp.zeros(n, dtype=jnp.int32)
+        # null flag outranks the value within each sort key
+        keys.append(nullk)
+        keys.append(v)
+    # jnp.lexsort: last key is primary => reverse (primary-first -> last)
+    return jnp.lexsort(tuple(reversed(keys)))
+
+
+# ---------------------------------------------------------------------------
+# grouping
+# ---------------------------------------------------------------------------
+
+
+def group_ids(key_cols):
+    """Sort-based grouping.
+
+    Returns (gids, ngroups, rep_indices): per-row dense group id, group count,
+    and the row index of each group's first occurrence (for key gathers).
+    SQL GROUP BY treats nulls as equal, which the (null-flag, value) composite
+    keys preserve.
+    """
+    n = len(key_cols[0])
+    if n == 0:
+        return jnp.zeros(0, dtype=jnp.int64), 0, jnp.zeros(0, dtype=jnp.int64)
+    order = lexsort_indices(key_cols)
+    boundary = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for col in key_cols:
+        v = sortable_view(col)
+        if col.valid is not None:
+            # zero data under nulls: all-null rows must compare equal
+            v = jnp.where(col.valid, v, jnp.zeros((), dtype=v.dtype))
+        sv = jnp.take(v, order)
+        neq = jnp.concatenate([jnp.ones(1, dtype=bool), sv[1:] != sv[:-1]])
+        if col.valid is not None:
+            nv = jnp.take(col.valid, order)
+            neq = neq | jnp.concatenate([jnp.zeros(1, dtype=bool), nv[1:] != nv[:-1]])
+        boundary = boundary | neq
+    gid_sorted = jnp.cumsum(boundary) - 1
+    ngroups = int(gid_sorted[-1]) + 1
+    gids = jnp.zeros(n, dtype=gid_sorted.dtype).at[order].set(gid_sorted)
+    rep = jnp.take(order, jnp.nonzero(boundary)[0])
+    return gids, ngroups, rep
+
+
+# ---------------------------------------------------------------------------
+# aggregation kernels
+# ---------------------------------------------------------------------------
+
+_F64_MIN = jnp.finfo(jnp.float64).min
+_F64_MAX = jnp.finfo(jnp.float64).max
+_I64_MIN = jnp.iinfo(jnp.int64).min
+_I64_MAX = jnp.iinfo(jnp.int64).max
+
+
+def agg_count(col: Column | None, gids, ngroups) -> Column:
+    """count(*) when col is None else count(col) (non-null)."""
+    if col is None:
+        ones = jnp.ones(gids.shape[0], dtype=jnp.int64)
+    else:
+        ones = col.valid_mask().astype(jnp.int64)
+    out = jax.ops.segment_sum(ones, gids, num_segments=ngroups)
+    return Column("i64", out)
+
+
+def agg_sum(col: Column, gids, ngroups) -> Column:
+    valid = col.valid_mask()
+    data = jnp.where(valid, col.data, 0)
+    if col.kind == "f64":
+        out = jax.ops.segment_sum(data, gids, num_segments=ngroups)
+        kind = "f64"
+    else:
+        out = jax.ops.segment_sum(data.astype(jnp.int64), gids, num_segments=ngroups)
+        kind = f"dec(38,{col.scale})" if is_dec(col.kind) else "i64"
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int32), gids, num_segments=ngroups)
+    return Column(kind, out, cnt > 0)
+
+
+def agg_min(col: Column, gids, ngroups, is_max=False) -> Column:
+    valid = col.valid_mask()
+    if col.kind == "f64":
+        sentinel = _F64_MIN if is_max else _F64_MAX
+    else:
+        sentinel = _I64_MIN if is_max else _I64_MAX
+    view = sortable_view(col)
+    work = view.astype(jnp.float64) if col.kind == "f64" else view.astype(jnp.int64)
+    data = jnp.where(valid, work, sentinel)
+    seg = jax.ops.segment_max if is_max else jax.ops.segment_min
+    out = seg(data, gids, num_segments=ngroups)
+    cnt = jax.ops.segment_sum(valid.astype(jnp.int32), gids, num_segments=ngroups)
+    out_valid = cnt > 0
+    if col.kind == "str":
+        # min/max of strings: map the winning rank back to a dictionary code
+        order = np.argsort(col.dict_values.astype(str), kind="stable")
+        rank_to_code = jnp.asarray(order.astype(np.int64))
+        codes = jnp.take(rank_to_code, jnp.clip(out, 0, len(order) - 1))
+        return Column("str", codes.astype(jnp.int32), out_valid, col.dict_values)
+    if col.kind == "f64":
+        return Column("f64", out, out_valid)
+    return Column(col.kind, out.astype(col.data.dtype), out_valid)
+
+
+def agg_avg(col: Column, gids, ngroups) -> Column:
+    valid = col.valid_mask()
+    data = jnp.where(valid, col.data, 0).astype(jnp.float64)
+    if is_dec(col.kind):
+        data = data / (10.0 ** col.scale)
+    s = jax.ops.segment_sum(data, gids, num_segments=ngroups)
+    c = jax.ops.segment_sum(valid.astype(jnp.float64), gids, num_segments=ngroups)
+    out = jnp.where(c > 0, s / jnp.maximum(c, 1.0), 0.0)
+    return Column("f64", out, c > 0)
+
+
+def agg_stddev_samp(col: Column, gids, ngroups) -> Column:
+    valid = col.valid_mask()
+    data = jnp.where(valid, col.data, 0).astype(jnp.float64)
+    if is_dec(col.kind):
+        data = data / (10.0 ** col.scale)
+    s1 = jax.ops.segment_sum(data, gids, num_segments=ngroups)
+    s2 = jax.ops.segment_sum(data * data, gids, num_segments=ngroups)
+    c = jax.ops.segment_sum(valid.astype(jnp.float64), gids, num_segments=ngroups)
+    mean = s1 / jnp.maximum(c, 1.0)
+    var = (s2 - c * mean * mean) / jnp.maximum(c - 1.0, 1.0)
+    var = jnp.maximum(var, 0.0)
+    out = jnp.sqrt(var)
+    return Column("f64", out, c > 1)
+
+
+# ---------------------------------------------------------------------------
+# filter / compact
+# ---------------------------------------------------------------------------
+
+
+def filter_table(table: DeviceTable, predicate: Column) -> DeviceTable:
+    """Keep rows where the predicate is true (SQL: null counts as false)."""
+    mask = predicate.data.astype(bool)
+    if predicate.valid is not None:
+        mask = mask & predicate.valid
+    idx = jnp.nonzero(mask)[0]
+    return table.take(idx)
+
+
+# ---------------------------------------------------------------------------
+# joins
+# ---------------------------------------------------------------------------
+
+_HASH_C1 = np.uint64(0x9E3779B97F4A7C15)
+_HASH_C2 = np.uint64(0xBF58476D1CE4E5B9)
+
+
+def _mix64(x: jnp.ndarray) -> jnp.ndarray:
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 30)) * jnp.uint64(_HASH_C2)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> 31)
+
+
+def _key_hash(cols, side_salt: int, null_safe: bool = False) -> jnp.ndarray:
+    """64-bit composite hash of the key columns.
+
+    Default SQL join semantics: rows with any null key get a per-row unique
+    value that cannot match the other side (null joins nothing). With
+    ``null_safe`` (set operations, null-safe equality), the null flag is
+    folded into the hash instead so null keys compare equal."""
+    n = len(cols[0])
+    h = jnp.full(n, jnp.uint64(0x243F6A8885A308D3), dtype=jnp.uint64)
+    any_null = jnp.zeros(n, dtype=bool)
+    for col in cols:
+        v = col.data
+        if col.kind == "f64":
+            v = jax.lax.bitcast_convert_type(v, jnp.int64)
+        v = v.astype(jnp.uint64)
+        # the null-marker mix must be applied identically on both join sides,
+        # including columns with no mask at all
+        if col.valid is not None:
+            v = jnp.where(col.valid, v, jnp.uint64(0))
+            marker = jnp.where(col.valid, jnp.uint64(0),
+                               jnp.uint64(0xA5A5A5A5A5A5A5A5))
+            any_null = any_null | ~col.valid
+        else:
+            marker = jnp.zeros(n, dtype=jnp.uint64)
+        h = _mix64(h ^ marker)
+        h = _mix64(h ^ v * jnp.uint64(_HASH_C1))
+    if null_safe:
+        return h | jnp.uint64(4)
+    row_ids = jnp.arange(n, dtype=jnp.uint64)
+    sentinel = jnp.uint64(1 if side_salt else 2) + (row_ids << jnp.uint64(2))
+    return jnp.where(any_null, sentinel, h | jnp.uint64(4))
+
+
+def _verify_pairs(l_idx, r_idx, left_keys, right_keys,
+                  null_safe: bool = False) -> jnp.ndarray:
+    """Exact key equality for candidate pairs (hash-collision safety).
+    With ``null_safe``, null == null."""
+    ok = jnp.ones(l_idx.shape[0], dtype=bool)
+    for lk, rk in zip(left_keys, right_keys):
+        if lk.kind == "str" and rk.kind == "str":
+            # dictionary codes come from different dicts; compare via ranks in
+            # a merged ordering
+            lmap, rmap = ordered_codes_merged(lk, rk)
+            lv = jnp.take(lmap, l_idx)
+            rv = jnp.take(rmap, r_idx)
+        else:
+            lv = jnp.take(lk.data, l_idx)
+            rv = jnp.take(rk.data, r_idx)
+        eq = lv == rv
+        lvalid = None if lk.valid is None else jnp.take(lk.valid, l_idx)
+        rvalid = None if rk.valid is None else jnp.take(rk.valid, r_idx)
+        if null_safe:
+            lnull = jnp.zeros_like(eq) if lvalid is None else ~lvalid
+            rnull = jnp.zeros_like(eq) if rvalid is None else ~rvalid
+            eq = jnp.where(lnull | rnull, lnull & rnull, eq)
+        else:
+            if lvalid is not None:
+                eq = eq & lvalid
+            if rvalid is not None:
+                eq = eq & rvalid
+        ok = ok & eq
+    return ok
+
+
+_merged_cache: dict = {}
+_MERGED_CACHE_MAX = 256
+
+
+def ordered_codes_merged(a: Column, b: Column):
+    """Map two string columns' codes into one shared value ordering.
+
+    Cached by identity of the two dictionaries; the cache holds references to
+    the keyed arrays so a recycled id can never alias a freed dictionary, and
+    it is bounded (FIFO evict) so long benchmark runs don't leak."""
+    key = (id(a.dict_values), id(b.dict_values))
+    hit = _merged_cache.get(key)
+    if hit is not None and hit[0] is a.dict_values and hit[1] is b.dict_values:
+        _, _, a_map, b_map = hit
+    else:
+        union, inverse = np.unique(
+            np.concatenate([a.dict_values.astype(str), b.dict_values.astype(str)]),
+            return_inverse=True)
+        a_map = jnp.asarray(inverse[: len(a.dict_values)].astype(np.int64))
+        b_map = jnp.asarray(inverse[len(a.dict_values):].astype(np.int64))
+        if len(_merged_cache) >= _MERGED_CACHE_MAX:
+            _merged_cache.pop(next(iter(_merged_cache)))
+        _merged_cache[key] = (a.dict_values, b.dict_values, a_map, b_map)
+    return jnp.take(a_map, a.data), jnp.take(b_map, b.data)
+
+
+def join_indices(left_keys, right_keys, how: str = "inner",
+                 null_safe: bool = False):
+    """Equi-join. Returns (l_idx, r_idx, l_extra, r_extra):
+    matched pair indices plus (for outer joins) the unmatched row indices of
+    each side to be padded with nulls.
+    """
+    n_left = len(left_keys[0])
+    n_right = len(right_keys[0])
+    lh = _key_hash(left_keys, 0, null_safe)
+    rh = _key_hash(right_keys, 1, null_safe)
+    order = jnp.argsort(rh)
+    rh_sorted = jnp.take(rh, order)
+    lo = jnp.searchsorted(rh_sorted, lh, side="left")
+    hi = jnp.searchsorted(rh_sorted, lh, side="right")
+    counts = hi - lo
+    total = int(jnp.sum(counts))
+    if total > 0:
+        l_idx = jnp.repeat(jnp.arange(n_left), counts, total_repeat_length=total)
+        starts = jnp.cumsum(counts) - counts
+        pos = jnp.arange(total) - jnp.repeat(starts, counts, total_repeat_length=total)
+        r_pos = jnp.repeat(lo, counts, total_repeat_length=total) + pos
+        r_idx = jnp.take(order, r_pos)
+        ok = _verify_pairs(l_idx, r_idx, left_keys, right_keys, null_safe)
+        keep = jnp.nonzero(ok)[0]
+        l_idx = jnp.take(l_idx, keep)
+        r_idx = jnp.take(r_idx, keep)
+    else:
+        l_idx = jnp.zeros(0, dtype=jnp.int64)
+        r_idx = jnp.zeros(0, dtype=jnp.int64)
+
+    l_extra = r_extra = None
+    if how in ("left", "full"):
+        matched = jnp.zeros(n_left, dtype=bool).at[l_idx].set(True)
+        l_extra = jnp.nonzero(~matched)[0]
+    if how in ("right", "full"):
+        matched_r = jnp.zeros(n_right, dtype=bool).at[r_idx].set(True)
+        r_extra = jnp.nonzero(~matched_r)[0]
+    return l_idx, r_idx, l_extra, r_extra
+
+
+def semi_join_mask(left_keys, right_keys, negate: bool = False,
+                   null_safe: bool = False) -> jnp.ndarray:
+    """Boolean per-left-row mask: has (semi) / lacks (anti) a match on the
+    right. Used for IN / EXISTS / NOT EXISTS and (null-safe) set ops."""
+    l_idx, _, _, _ = join_indices(left_keys, right_keys, "inner", null_safe)
+    n_left = len(left_keys[0])
+    matched = jnp.zeros(n_left, dtype=bool).at[l_idx].set(True)
+    return ~matched if negate else matched
+
+
+def _null_column_like(col: Column, n: int) -> Column:
+    data = jnp.zeros((n,) + col.data.shape[1:], dtype=col.data.dtype)
+    return Column(col.kind, data, jnp.zeros(n, dtype=bool), col.dict_values)
+
+
+def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
+                how: str = "inner") -> DeviceTable:
+    """Materialized equi-join of two tables; column name collisions must be
+    resolved by the caller (planner aliases)."""
+    l_idx, r_idx, l_extra, r_extra = join_indices(
+        [left[c] for c in left_on], [right[c] for c in right_on], how)
+    out = {}
+    n_matched = int(l_idx.shape[0])
+    n_lx = 0 if l_extra is None else int(l_extra.shape[0])
+    n_rx = 0 if r_extra is None else int(r_extra.shape[0])
+    for name, col in left.columns.items():
+        parts = [col.take(l_idx)]
+        if n_lx:
+            parts.append(col.take(l_extra))
+        if n_rx:
+            parts.append(_null_column_like(col, n_rx))
+        out[name] = concat_columns(parts)
+    for name, col in right.columns.items():
+        parts = [col.take(r_idx)]
+        if n_lx:
+            parts.append(_null_column_like(col, n_lx))
+        if n_rx:
+            parts.append(col.take(r_extra))
+        out[name] = concat_columns(parts)
+    return DeviceTable(out, n_matched + n_lx + n_rx)
+
+
+# ---------------------------------------------------------------------------
+# concatenation (UNION ALL) with dictionary merging
+# ---------------------------------------------------------------------------
+
+
+def concat_columns(cols) -> Column:
+    kind = cols[0].kind
+    if kind == "str":
+        dicts = [c.dict_values for c in cols]
+        same = all(d is dicts[0] for d in dicts)
+        if not same:
+            union, inverse = np.unique(
+                np.concatenate([d.astype(str) for d in dicts]), return_inverse=True)
+            maps, off = [], 0
+            for d in dicts:
+                maps.append(jnp.asarray(inverse[off:off + len(d)].astype(np.int32)))
+                off += len(d)
+            datas = [jnp.take(m, c.data) for m, c in zip(maps, cols)]
+            dict_values = union.astype(object)
+        else:
+            datas = [c.data for c in cols]
+            dict_values = dicts[0]
+        data = jnp.concatenate(datas)
+        valid = _concat_valids(cols)
+        return Column("str", data.astype(jnp.int32), valid, dict_values)
+    data = jnp.concatenate([c.data for c in cols])
+    return Column(kind, data, _concat_valids(cols))
+
+
+def _concat_valids(cols):
+    if all(c.valid is None for c in cols):
+        return None
+    return jnp.concatenate([c.valid_mask() for c in cols])
+
+
+def concat_tables(tables) -> DeviceTable:
+    names = tables[0].column_names
+    out = {n: concat_columns([t[n] for t in tables]) for n in names}
+    return DeviceTable(out, sum(t.nrows for t in tables))
+
+
+# ---------------------------------------------------------------------------
+# sort / limit
+# ---------------------------------------------------------------------------
+
+
+def sort_table(table: DeviceTable, keys, descending=None, nulls_last=None) -> DeviceTable:
+    order = lexsort_indices([table[k] if isinstance(k, str) else k for k in keys],
+                            descending, nulls_last)
+    return table.take(order)
+
+
+def limit_table(table: DeviceTable, n: int) -> DeviceTable:
+    idx = jnp.arange(min(n, table.nrows))
+    return table.take(idx)

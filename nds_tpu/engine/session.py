@@ -1,0 +1,121 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Session: the engine's user-facing entry point (the role SparkSession plays
+for the reference drivers; ref: nds/nds_power.py:204-248).
+
+Holds the table catalog and configuration, parses and executes SQL, and
+exposes collect()/write() result surfaces. DML (INSERT/DELETE for Data
+Maintenance) routes through the snapshot warehouse when one is attached.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pyarrow as pa
+
+from nds_tpu.engine.column import from_arrow
+from nds_tpu.engine.table import DeviceTable
+from nds_tpu.sql import ast as A
+from nds_tpu.sql.parser import parse
+from nds_tpu.sql.planner import ExecError, Planner
+
+
+class Result:
+    """A materialized query result."""
+
+    def __init__(self, table: DeviceTable):
+        self.table = table
+
+    @property
+    def num_rows(self) -> int:
+        return self.table.nrows
+
+    @property
+    def column_names(self):
+        return self.table.column_names
+
+    def to_arrow(self) -> pa.Table:
+        return self.table.to_arrow()
+
+    def collect(self):
+        """Device -> host gather; returns list of row tuples (the reference's
+        df.collect() contract; ref: nds/nds_power.py:125-135)."""
+        arrow = self.to_arrow()
+        cols = [arrow.column(i).to_pylist() for i in range(arrow.num_columns)]
+        return list(zip(*cols)) if cols else []
+
+    def write(self, path: str, fmt: str = "parquet"):
+        from nds_tpu.io.columnar import write_table
+        write_table(self.to_arrow(), path, fmt)
+
+
+class Session:
+    def __init__(self, conf: dict | None = None):
+        self.conf = dict(conf or {})
+        self.catalog: dict[str, DeviceTable] = {}
+        self.warehouse = None            # attached by maintenance driver
+        self.view_setup_times: list = [] # (name, ms) like setup_tables timing
+
+    # -- catalog ------------------------------------------------------------
+
+    def create_temp_view(self, name: str, table) -> None:
+        if isinstance(table, pa.Table):
+            table = from_arrow(table)
+        self.catalog[name.lower()] = table
+
+    def read_raw_view(self, name: str, path: str, fields) -> float:
+        """Register a raw '|'-delimited table; returns elapsed seconds (the
+        per-view creation timing in the reference's setup_tables;
+        ref: nds/nds_power.py:79-106)."""
+        from nds_tpu.io import read_raw_table
+        start = time.perf_counter()
+        arrow = read_raw_table(path, fields)
+        canonical = {f.name: f.type for f in fields}
+        self.create_temp_view(name, from_arrow(arrow, canonical))
+        return time.perf_counter() - start
+
+    def read_columnar_view(self, name: str, path: str, fmt: str = "parquet",
+                           canonical_types: dict | None = None) -> float:
+        from nds_tpu.io import read_table
+        start = time.perf_counter()
+        arrow = read_table(path, fmt)
+        self.create_temp_view(name, from_arrow(arrow, canonical_types))
+        return time.perf_counter() - start
+
+    # -- SQL ----------------------------------------------------------------
+
+    def sql(self, text: str) -> Result:
+        stmt = parse(text)
+        planner = Planner(self.catalog)
+        if isinstance(stmt, A.Query):
+            return Result(planner.query(stmt))
+        if isinstance(stmt, A.CreateTempView):
+            self.catalog[stmt.name.lower()] = planner.query(stmt.query)
+            return Result(DeviceTable({}, 0))
+        if isinstance(stmt, A.InsertInto):
+            if self.warehouse is None:
+                raise ExecError("INSERT requires an attached warehouse")
+            rows = planner.query(stmt.query)
+            self.warehouse.insert(stmt.table, rows.to_arrow())
+            self.catalog[stmt.table.lower()] = from_arrow(
+                self.warehouse.read(stmt.table))
+            return Result(DeviceTable({}, 0))
+        if isinstance(stmt, A.DeleteFrom):
+            if self.warehouse is None:
+                raise ExecError("DELETE requires an attached warehouse")
+            # evaluate the predicate against the current table; delete by mask
+            import jax.numpy as jnp
+            from nds_tpu.sql.planner import EvalCtx
+            table = self.catalog[stmt.table.lower()]
+            aliased = planner._alias_table(table, stmt.table)
+            if stmt.where is None:
+                keep = jnp.zeros(0, dtype=jnp.int64)
+            else:
+                mask = planner._conjunct_mask(aliased,
+                                              planner._split_conjuncts(stmt.where))
+                keep = jnp.nonzero(~mask)[0]
+            kept = table.take(keep)
+            self.warehouse.overwrite(stmt.table, kept.to_arrow())
+            self.catalog[stmt.table.lower()] = kept
+            return Result(DeviceTable({}, 0))
+        raise ExecError(f"unsupported statement {type(stmt).__name__}")

@@ -1,0 +1,54 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""DeviceTable: an ordered set of named device columns of equal length."""
+
+from __future__ import annotations
+
+from nds_tpu.engine.column import Column
+
+
+class DeviceTable:
+    def __init__(self, columns: dict[str, Column], nrows: int | None = None):
+        self.columns = dict(columns)
+        if nrows is None:
+            nrows = len(next(iter(columns.values()))) if columns else 0
+        self.nrows = nrows
+
+    @property
+    def column_names(self):
+        return list(self.columns.keys())
+
+    def __getitem__(self, name: str) -> Column:
+        return self.columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    def select(self, names) -> "DeviceTable":
+        return DeviceTable({n: self.columns[n] for n in names}, self.nrows)
+
+    def with_column(self, name: str, col: Column) -> "DeviceTable":
+        cols = dict(self.columns)
+        cols[name] = col
+        return DeviceTable(cols, self.nrows)
+
+    def rename(self, mapping: dict[str, str]) -> "DeviceTable":
+        return DeviceTable(
+            {mapping.get(n, n): c for n, c in self.columns.items()}, self.nrows)
+
+    def take(self, indices) -> "DeviceTable":
+        cols = {n: c.take(indices) for n, c in self.columns.items()}
+        n = int(indices.shape[0])
+        return DeviceTable(cols, n)
+
+    def to_arrow(self):
+        from nds_tpu.engine.column import to_arrow
+        return to_arrow(self)
+
+    @staticmethod
+    def from_arrow(table, canonical_types=None) -> "DeviceTable":
+        from nds_tpu.engine.column import from_arrow
+        return from_arrow(table, canonical_types)
+
+    def __repr__(self):
+        cols = ", ".join(f"{n}:{c.kind}" for n, c in self.columns.items())
+        return f"DeviceTable[{self.nrows} rows]({cols})"

@@ -1,0 +1,148 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Window functions: rank/row_number/dense_rank and partition aggregates.
+
+Implementation: one lexsort over (partition keys, order keys), segment
+boundary detection, then prefix-scan arithmetic within segments — all static
+dtype device ops. Results scatter back to the original row order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nds_tpu.engine.column import Column, is_dec
+from nds_tpu.engine.ops import lexsort_indices, sortable_view
+
+
+def _boundaries(cols, order):
+    """Sorted-order boundary mask: True where a new run of equal keys starts."""
+    n = int(order.shape[0])
+    b = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for col in cols:
+        v = sortable_view(col)
+        if col.valid is not None:
+            v = jnp.where(col.valid, v, jnp.zeros((), dtype=v.dtype))
+        sv = jnp.take(v, order)
+        b = b | jnp.concatenate([jnp.ones(1, dtype=bool), sv[1:] != sv[:-1]])
+        if col.valid is not None:
+            nv = jnp.take(col.valid, order)
+            b = b | jnp.concatenate([jnp.zeros(1, dtype=bool), nv[1:] != nv[:-1]])
+    return b
+
+
+class WindowContext:
+    """One sort shared by every window function over the same
+    (partition, order) spec."""
+
+    def __init__(self, partition_cols, order_cols=(), descending=None, nulls_last=None):
+        self.n = len(partition_cols[0]) if partition_cols else len(order_cols[0])
+        all_cols = list(partition_cols) + list(order_cols)
+        desc = [False] * len(partition_cols) + list(
+            descending or [False] * len(order_cols))
+        nl = [False] * len(partition_cols) + list(
+            nulls_last or [d for d in (descending or [False] * len(order_cols))])
+        self.order = lexsort_indices(all_cols, desc, nl)
+        self.part_boundary = (_boundaries(partition_cols, self.order)
+                              if partition_cols
+                              else jnp.zeros(self.n, dtype=bool).at[0].set(True))
+        self.gid_sorted = jnp.cumsum(self.part_boundary) - 1
+        self.ngroups = int(self.gid_sorted[-1]) + 1 if self.n else 0
+        pos = jnp.arange(self.n)
+        # start position of each row's segment
+        seg_starts = jnp.where(self.part_boundary, pos, 0)
+        self.start_for_row = jax.ops.segment_max(
+            seg_starts, self.gid_sorted, num_segments=self.ngroups)[self.gid_sorted]
+        self.pos = pos
+        self.order_boundary = (self.part_boundary |
+                               _boundaries(order_cols, self.order)
+                               if order_cols else self.part_boundary)
+
+    def _scatter(self, sorted_vals, kind="i64", valid_sorted=None, dict_values=None):
+        out = jnp.zeros(self.n, dtype=sorted_vals.dtype).at[self.order].set(sorted_vals)
+        valid = None
+        if valid_sorted is not None:
+            valid = jnp.zeros(self.n, dtype=bool).at[self.order].set(valid_sorted)
+        return Column(kind, out, valid, dict_values)
+
+    def row_number(self) -> Column:
+        rn = self.pos - self.start_for_row + 1
+        return self._scatter(rn.astype(jnp.int64))
+
+    def rank(self) -> Column:
+        # rank = position of the last order-boundary at or before this row
+        last_b = jax.lax.cummax(jnp.where(self.order_boundary, self.pos, -1))
+        rk = last_b - self.start_for_row + 1
+        return self._scatter(rk.astype(jnp.int64))
+
+    def dense_rank(self) -> Column:
+        cb = jnp.cumsum(self.order_boundary)
+        cb_at_start = jax.ops.segment_max(
+            jnp.where(self.part_boundary, cb, 0), self.gid_sorted,
+            num_segments=self.ngroups)[self.gid_sorted]
+        dr = cb - cb_at_start + 1
+        return self._scatter(dr.astype(jnp.int64))
+
+    def partition_agg(self, col: Column, agg: str) -> Column:
+        """sum/avg/min/max/count over the whole partition, broadcast per row."""
+        valid = jnp.take(col.valid_mask(), self.order)
+        data = jnp.take(col.data, self.order)
+        if agg == "count":
+            red = jax.ops.segment_sum(valid.astype(jnp.int64), self.gid_sorted,
+                                      num_segments=self.ngroups)
+            per_row = red[self.gid_sorted]
+            return self._scatter(per_row, "i64")
+        if agg in ("sum", "avg"):
+            f = data.astype(jnp.float64) if col.kind == "f64" else data.astype(jnp.int64)
+            f = jnp.where(valid, f, 0)
+            s = jax.ops.segment_sum(f, self.gid_sorted, num_segments=self.ngroups)
+            c = jax.ops.segment_sum(valid.astype(jnp.int64), self.gid_sorted,
+                                    num_segments=self.ngroups)
+            if agg == "avg":
+                sf = s.astype(jnp.float64)
+                if is_dec(col.kind):
+                    sf = sf / (10.0 ** col.scale)
+                per_row = (sf / jnp.maximum(c, 1))[self.gid_sorted]
+                return self._scatter(per_row, "f64", valid_sorted=(c > 0)[self.gid_sorted])
+            per_row = s[self.gid_sorted]
+            kind = ("f64" if col.kind == "f64"
+                    else (f"dec(38,{col.scale})" if is_dec(col.kind) else "i64"))
+            return self._scatter(per_row, kind, valid_sorted=(c > 0)[self.gid_sorted])
+        if agg in ("min", "max"):
+            big = jnp.iinfo(jnp.int64).max if col.kind != "f64" else jnp.inf
+            sent = -big if agg == "max" else big
+            f = data.astype(jnp.float64) if col.kind == "f64" else data.astype(jnp.int64)
+            f = jnp.where(valid, f, sent)
+            seg = jax.ops.segment_max if agg == "max" else jax.ops.segment_min
+            red = seg(f, self.gid_sorted, num_segments=self.ngroups)
+            c = jax.ops.segment_sum(valid.astype(jnp.int64), self.gid_sorted,
+                                    num_segments=self.ngroups)
+            per_row = red[self.gid_sorted]
+            kind = "f64" if col.kind == "f64" else (col.kind if is_dec(col.kind) else "i64")
+            if col.kind != "f64":
+                per_row = per_row.astype(jnp.int64)
+            return self._scatter(per_row, kind, valid_sorted=(c > 0)[self.gid_sorted])
+        raise ValueError(f"unsupported window aggregate: {agg}")
+
+    def running_sum(self, col: Column) -> Column:
+        """sum() over (partition ... order ... rows unbounded preceding)."""
+        valid = jnp.take(col.valid_mask(), self.order)
+        data = jnp.take(col.data, self.order)
+        f = data.astype(jnp.float64) if col.kind == "f64" else data.astype(jnp.int64)
+        f = jnp.where(valid, f, 0)
+        c = jnp.cumsum(f)
+        # subtract the cumsum just before each segment start; exactly one
+        # nonzero candidate per segment, so segment_sum extracts it (works for
+        # negative running sums where a max would not)
+        c_before = jnp.where(self.part_boundary, c - f, 0)
+        off = jax.ops.segment_sum(c_before, self.gid_sorted,
+                                  num_segments=self.ngroups)[self.gid_sorted]
+        run = c - off
+        vcount = jnp.cumsum(valid.astype(jnp.int64))
+        v_before = jnp.where(self.part_boundary, vcount - valid.astype(jnp.int64), 0)
+        voff = jax.ops.segment_max(v_before, self.gid_sorted,
+                                   num_segments=self.ngroups)[self.gid_sorted]
+        has_any = (vcount - voff) > 0
+        kind = ("f64" if col.kind == "f64"
+                else (f"dec(38,{col.scale})" if is_dec(col.kind) else "i64"))
+        return self._scatter(run, kind, valid_sorted=has_any)

@@ -1,0 +1,6 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""SQL frontend: lexer, parser, and planner lowering Spark-dialect SQL (the
+dialect the query templates generate; ref: nds/tpcds-gen/patches/
+templates.patch spark.tpl) onto the columnar engine."""
+
+from nds_tpu.sql.parser import parse  # noqa: F401

@@ -1,0 +1,252 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Engine operator tests against a pandas oracle."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine import DeviceTable, from_arrow
+from nds_tpu.engine import ops as E
+from nds_tpu.engine import exprs as X
+from nds_tpu.engine.window import WindowContext
+
+
+def make_table(n=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 20, n)
+    v = rng.integers(-100, 100, n).astype(np.int64)
+    price_cents = rng.integers(0, 10000, n)
+    s = rng.choice(["apple", "pear", "fig", "kiwi", None], n, p=[0.3, 0.3, 0.2, 0.1, 0.1])
+    f = rng.normal(size=n)
+    v_null = rng.random(n) < 0.1
+    arrow = pa.table({
+        "k": pa.array(k, pa.int32()),
+        "v": pa.array([None if m else int(x) for x, m in zip(v, v_null)], pa.int64()),
+        "price": pa.array([int(x) for x in price_cents], pa.int64()).cast(
+            pa.decimal128(38, 0)).cast(pa.decimal128(9, 2), safe=False),
+        "s": pa.array(s, pa.string()),
+        "f": pa.array(f, pa.float64()),
+    })
+    # note: price cast path divides by 100 -> decimal with value cents/1 ... fix below
+    df = arrow.to_pandas()
+    return arrow, df
+
+
+def dev(arrow):
+    return from_arrow(arrow)
+
+
+def test_arrow_roundtrip():
+    arrow, _ = make_table()
+    dt = dev(arrow)
+    back = dt.to_arrow()
+    assert back.num_rows == arrow.num_rows
+    assert back["k"].to_pylist() == arrow["k"].to_pylist()
+    assert back["v"].to_pylist() == arrow["v"].to_pylist()
+    assert back["s"].to_pylist() == arrow["s"].to_pylist()
+    a = [float(x) if x is not None else None for x in arrow["price"].to_pylist()]
+    b = [float(x) if x is not None else None for x in back["price"].to_pylist()]
+    assert a == b
+
+
+def test_filter_matches_pandas():
+    arrow, df = make_table()
+    dt = dev(arrow)
+    pred = X.compare("<", dt["v"], X.literal(10, dt.nrows))
+    out = E.filter_table(dt, pred)
+    expected = df[df["v"] < 10]
+    assert out.nrows == len(expected)
+    got = out.to_arrow().to_pandas()
+    assert list(got["v"]) == list(expected["v"])
+
+
+def test_group_agg_matches_pandas():
+    arrow, df = make_table()
+    dt = dev(arrow)
+    gids, ng, rep = E.group_ids([dt["k"]])
+    s = E.agg_sum(dt["v"], gids, ng)
+    c = E.agg_count(None, gids, ng)
+    cnn = E.agg_count(dt["v"], gids, ng)
+    mn = E.agg_min(dt["v"], gids, ng)
+    mx = E.agg_min(dt["v"], gids, ng, is_max=True)
+    av = E.agg_avg(dt["v"], gids, ng)
+    keys = dt["k"].take(rep)
+    got = pd.DataFrame({
+        "k": np.asarray(keys.data),
+        "sum": np.asarray(s.data),
+        "cnt": np.asarray(c.data),
+        "cntv": np.asarray(cnn.data),
+        "min": np.asarray(mn.data),
+        "max": np.asarray(mx.data),
+        "avg": np.asarray(av.data),
+    }).sort_values("k").reset_index(drop=True)
+    exp = df.groupby("k").agg(
+        sum=("v", lambda x: x.sum()),
+        cnt=("v", "size"),
+        cntv=("v", "count"),
+        min=("v", "min"),
+        max=("v", "max"),
+        avg=("v", "mean"),
+    ).reset_index().sort_values("k").reset_index(drop=True)
+    assert list(got["k"]) == list(exp["k"])
+    assert list(got["sum"]) == [int(x) for x in exp["sum"]]
+    assert list(got["cnt"]) == list(exp["cnt"])
+    assert list(got["cntv"]) == list(exp["cntv"])
+    assert list(got["min"]) == [int(x) for x in exp["min"]]
+    assert list(got["max"]) == [int(x) for x in exp["max"]]
+    np.testing.assert_allclose(got["avg"], exp["avg"], rtol=1e-12)
+
+
+def test_group_by_string_with_nulls():
+    arrow, df = make_table()
+    dt = dev(arrow)
+    gids, ng, rep = E.group_ids([dt["s"]])
+    c = E.agg_count(None, gids, ng)
+    keys = dt["s"].take(rep)
+    got = {}
+    kcol = keys
+    vals = kcol.dict_values[np.asarray(kcol.data)]
+    valid = np.ones(len(kcol), bool) if kcol.valid is None else np.asarray(kcol.valid)
+    for i in range(ng):
+        got[vals[i] if valid[i] else None] = int(np.asarray(c.data)[i])
+    exp = df.groupby("s", dropna=False)["s"].size().to_dict()
+    exp = {(None if (isinstance(k, float) or k is None) else k): v for k, v in exp.items()}
+    assert got == exp
+
+
+def test_join_matches_pandas():
+    rng = np.random.default_rng(1)
+    left = pa.table({"a": pa.array(rng.integers(0, 50, 300), pa.int64()),
+                     "x": pa.array(rng.integers(0, 10, 300), pa.int64())})
+    right = pa.table({"b": pa.array(rng.integers(0, 50, 80), pa.int64()),
+                      "y": pa.array(rng.integers(0, 10, 80), pa.int64())})
+    lt, rt = dev(left), dev(right)
+    out = E.join_tables(lt, rt, ["a"], ["b"], "inner")
+    got = out.to_arrow().to_pandas().sort_values(["a", "x", "y"]).reset_index(drop=True)
+    exp = left.to_pandas().merge(right.to_pandas(), left_on="a", right_on="b",
+                                 how="inner").sort_values(["a", "x", "y"]).reset_index(drop=True)
+    assert len(got) == len(exp)
+    assert list(got["a"]) == list(exp["a"])
+    assert list(got["y"]) == list(exp["y"])
+
+
+def test_left_join_with_nulls():
+    left = pa.table({"a": pa.array([1, 2, None, 4], pa.int64())})
+    right = pa.table({"b": pa.array([1, 1, None], pa.int64()),
+                      "z": pa.array([10, 20, 30], pa.int64())})
+    out = E.join_tables(dev(left), dev(right), ["a"], ["b"], "left")
+    got = out.to_arrow().to_pandas()
+    # null keys match nothing; row 1 matches twice; rows 2,None,4 unmatched
+    assert len(got) == 5
+    matched = got[got["z"].notna()]
+    assert sorted(matched["z"]) == [10, 20]
+    assert matched["a"].tolist() == [1, 1]
+
+
+def test_semi_anti_join():
+    left = pa.table({"a": pa.array([1, 2, 3, None], pa.int64())})
+    right = pa.table({"b": pa.array([2, 3], pa.int64())})
+    lt, rt = dev(left), dev(right)
+    semi = np.asarray(E.semi_join_mask([lt["a"]], [rt["b"]]))
+    anti = np.asarray(E.semi_join_mask([lt["a"]], [rt["b"]], negate=True))
+    assert semi.tolist() == [False, True, True, False]
+    assert anti.tolist() == [True, False, False, True]
+
+
+def test_sort_with_nulls_and_desc():
+    arrow, df = make_table(200)
+    dt = dev(arrow)
+    out = E.sort_table(dt, ["v"], descending=[True], nulls_last=[True])
+    got = out.to_arrow().to_pandas()["v"]
+    exp = df.sort_values("v", ascending=False, na_position="last",
+                         kind="stable")["v"]
+    assert [x if pd.notna(x) else None for x in got] == \
+           [x if pd.notna(x) else None for x in exp]
+
+
+def test_string_sort():
+    arrow, df = make_table(200)
+    dt = dev(arrow)
+    out = E.sort_table(dt, ["s"], nulls_last=[False])
+    got = out.to_arrow().to_pandas()["s"]
+    exp = df.sort_values("s", na_position="first", kind="stable")["s"]
+    assert [x if pd.notna(x) else None for x in got] == \
+           [x if pd.notna(x) else None for x in exp]
+
+
+def test_decimal_arith_exact():
+    arrow, df = make_table()
+    dt = dev(arrow)
+    qty = X.literal(3, dt.nrows)
+    ext = X.arith("*", dt["price"], qty)
+    assert ext.kind == "dec(38,2)"
+    got = np.asarray(ext.data)
+    exp = np.round(df["price"].astype(float) * 3 * 100).astype(np.int64)
+    np.testing.assert_array_equal(got, exp)
+    total = X.arith("+", ext, dt["price"])
+    got2 = np.asarray(total.data)
+    np.testing.assert_array_equal(got2, exp + np.asarray(dt["price"].data))
+
+
+def test_case_when_and_coalesce():
+    arrow, df = make_table()
+    dt = dev(arrow)
+    cond = X.compare(">", dt["v"], X.literal(0, dt.nrows))
+    res = X.case_when([(cond, X.literal(1, dt.nrows))], X.literal(0, dt.nrows))
+    got = np.asarray(res.data)
+    exp = (df["v"] > 0).astype(int).values
+    np.testing.assert_array_equal(got, exp)
+    co = X.coalesce([dt["v"], X.literal(-999, dt.nrows)])
+    got = np.asarray(co.data)[np.asarray(~dt["v"].valid_mask())]
+    assert (got == -999).all()
+
+
+def test_like_and_substr():
+    arrow, df = make_table()
+    dt = dev(arrow)
+    lk = X.fn_like(dt["s"], "%pp%")
+    got = out = np.asarray(lk.data) & np.asarray(lk.valid_mask())
+    exp = df["s"].str.contains("pp", na=False).values
+    np.testing.assert_array_equal(got, exp)
+    sub = X.fn_substr(dt["s"], 1, 2)
+    vals = sub.dict_values[np.asarray(sub.data)]
+    exp2 = df["s"].str[:2]
+    valid = np.asarray(sub.valid_mask())
+    for g, e, ok in zip(vals, exp2, valid):
+        if ok:
+            assert g == e
+
+
+def test_window_rank_rownumber():
+    arrow, df = make_table(500)
+    dt = dev(arrow)
+    ctx = WindowContext([dt["k"]], [dt["f"]], descending=[True])
+    rn = ctx.row_number()
+    rk = ctx.rank()
+    got = pd.DataFrame({"k": df["k"], "f": df["f"],
+                        "rn": np.asarray(rn.data), "rk": np.asarray(rk.data)})
+    exp_rn = df.groupby("k")["f"].rank(method="first", ascending=False).astype(int)
+    exp_rk = df.groupby("k")["f"].rank(method="min", ascending=False).astype(int)
+    np.testing.assert_array_equal(got["rn"].values, exp_rn.values)
+    np.testing.assert_array_equal(got["rk"].values, exp_rk.values)
+
+
+def test_window_partition_sum_avg():
+    arrow, df = make_table(500)
+    dt = dev(arrow)
+    ctx = WindowContext([dt["k"]])
+    s = ctx.partition_agg(dt["v"], "sum")
+    a = ctx.partition_agg(dt["v"], "avg")
+    exp_s = df.groupby("k")["v"].transform("sum")
+    exp_a = df.groupby("k")["v"].transform("mean")
+    np.testing.assert_array_equal(np.asarray(s.data), exp_s.values.astype(np.int64))
+    np.testing.assert_allclose(np.asarray(a.data), exp_a.values, rtol=1e-12)
+
+
+def test_union_all_dict_merge():
+    t1 = dev(pa.table({"s": pa.array(["a", "b", "a"])}))
+    t2 = dev(pa.table({"s": pa.array(["c", "b"])}))
+    out = E.concat_tables([t1, t2])
+    vals = out["s"].dict_values[np.asarray(out["s"].data)]
+    assert list(vals) == ["a", "b", "a", "c", "b"]

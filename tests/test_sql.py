@@ -1,0 +1,288 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""SQL end-to-end tests: engine results vs a pandas oracle."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine.session import Session
+
+
+@pytest.fixture(scope="module")
+def sess():
+    rng = np.random.default_rng(7)
+    n = 2000
+    sales = pa.table({
+        "item_sk": pa.array(rng.integers(1, 50, n), pa.int32()),
+        "cust_sk": pa.array([None if x < 3 else int(x) for x in
+                             rng.integers(1, 100, n)], pa.int32()),
+        "qty": pa.array(rng.integers(1, 20, n), pa.int64()),
+        "price": pa.array([int(x) for x in rng.integers(100, 9999, n)],
+                          pa.int64()).cast(pa.decimal128(38, 0)).cast(
+                              pa.decimal128(7, 2), safe=False),
+        "sold_date": pa.array(rng.integers(10000, 10100, n), pa.int32()),
+    })
+    items = pa.table({
+        "i_item_sk": pa.array(np.arange(1, 61), pa.int32()),
+        "i_brand": pa.array([f"brand{i % 7}" for i in range(60)]),
+        "i_category": pa.array(
+            [["Books", "Music", "Home"][i % 3] for i in range(60)]),
+        "i_price": pa.array([int(x) for x in rng.integers(100, 9999, 60)],
+                            pa.int64()).cast(pa.decimal128(38, 0)).cast(
+                                pa.decimal128(7, 2), safe=False),
+    })
+    custs = pa.table({
+        "c_cust_sk": pa.array(np.arange(1, 101), pa.int32()),
+        "c_state": pa.array([["CA", "TX", "NY", "WA"][i % 4] for i in range(100)]),
+    })
+    s = Session()
+    s.create_temp_view("sales", sales)
+    s.create_temp_view("item", items)
+    s.create_temp_view("cust", custs)
+    s._dfs = {"sales": sales.to_pandas(), "item": items.to_pandas(),
+              "cust": custs.to_pandas()}
+    return s
+
+
+def df_of(res):
+    return res.to_arrow().to_pandas()
+
+
+def test_simple_filter_project(sess):
+    out = df_of(sess.sql("select item_sk, qty from sales where qty > 15"))
+    exp = sess._dfs["sales"].query("qty > 15")[["item_sk", "qty"]]
+    assert len(out) == len(exp)
+    assert sorted(out["qty"]) == sorted(exp["qty"])
+
+
+def test_join_group_order_limit(sess):
+    out = df_of(sess.sql("""
+        select i_brand, sum(qty * price) total, count(*) cnt
+        from sales, item
+        where item_sk = i_item_sk and i_category = 'Books'
+        group by i_brand
+        order by total desc, i_brand
+        limit 5
+    """))
+    df = sess._dfs["sales"].merge(sess._dfs["item"], left_on="item_sk",
+                                  right_on="i_item_sk")
+    df = df[df["i_category"] == "Books"]
+    df["total"] = df["qty"] * df["price"].astype(float)
+    exp = df.groupby("i_brand").agg(total=("total", "sum"), cnt=("qty", "size")) \
+        .reset_index().sort_values(["total", "i_brand"],
+                                   ascending=[False, True]).head(5)
+    assert list(out["i_brand"]) == list(exp["i_brand"])
+    assert list(out["cnt"]) == list(exp["cnt"])
+    np.testing.assert_allclose([float(x) for x in out["total"]],
+                               exp["total"], rtol=1e-9)
+
+
+def test_agg_without_group(sess):
+    out = df_of(sess.sql("select count(*) c, avg(qty) a, min(qty) mn, max(qty) mx "
+                         "from sales where item_sk < 10"))
+    exp = sess._dfs["sales"].query("item_sk < 10")["qty"]
+    assert out["c"][0] == len(exp)
+    np.testing.assert_allclose(out["a"][0], exp.mean())
+    assert out["mn"][0] == exp.min() and out["mx"][0] == exp.max()
+
+
+def test_count_distinct(sess):
+    out = df_of(sess.sql(
+        "select item_sk, count(distinct cust_sk) cd from sales group by item_sk"))
+    exp = sess._dfs["sales"].groupby("item_sk")["cust_sk"].nunique()
+    got = dict(zip(out["item_sk"], out["cd"]))
+    for k, v in exp.items():
+        assert got[k] == v, k
+
+
+def test_case_when_sum(sess):
+    out = df_of(sess.sql("""
+        select sum(case when qty > 10 then 1 else 0 end) hi,
+               sum(case when qty <= 10 then 1 else 0 end) lo
+        from sales
+    """))
+    df = sess._dfs["sales"]
+    assert out["hi"][0] == (df["qty"] > 10).sum()
+    assert out["lo"][0] == (df["qty"] <= 10).sum()
+
+
+def test_having(sess):
+    out = df_of(sess.sql("""
+        select item_sk, count(*) c from sales group by item_sk
+        having count(*) > 50 order by item_sk
+    """))
+    exp = sess._dfs["sales"].groupby("item_sk").size()
+    exp = exp[exp > 50]
+    assert list(out["item_sk"]) == list(exp.index)
+    assert list(out["c"]) == list(exp.values)
+
+
+def test_in_list_and_like(sess):
+    out = df_of(sess.sql("""
+        select count(*) c from sales, item
+        where item_sk = i_item_sk and i_brand in ('brand1', 'brand3')
+          and i_category like 'B%'
+    """))
+    df = sess._dfs["sales"].merge(sess._dfs["item"], left_on="item_sk",
+                                  right_on="i_item_sk")
+    exp = df[df["i_brand"].isin(["brand1", "brand3"]) &
+             df["i_category"].str.startswith("B")]
+    assert out["c"][0] == len(exp)
+
+
+def test_uncorrelated_in_subquery(sess):
+    out = df_of(sess.sql("""
+        select count(*) c from sales
+        where item_sk in (select i_item_sk from item where i_category = 'Music')
+    """))
+    music = sess._dfs["item"].query("i_category == 'Music'")["i_item_sk"]
+    exp = sess._dfs["sales"][sess._dfs["sales"]["item_sk"].isin(music)]
+    assert out["c"][0] == len(exp)
+
+
+def test_correlated_exists(sess):
+    out = df_of(sess.sql("""
+        select count(*) c from cust
+        where exists (select 1 from sales where cust_sk = c_cust_sk and qty > 18)
+    """))
+    hot = sess._dfs["sales"].query("qty > 18")["cust_sk"].dropna().unique()
+    assert out["c"][0] == len(set(hot) & set(sess._dfs["cust"]["c_cust_sk"]))
+
+
+def test_correlated_scalar_subquery(sess):
+    out = df_of(sess.sql("""
+        select item_sk, qty from sales s1
+        where qty > (select avg(qty) * 1.2 from sales s2
+                     where s2.item_sk = s1.item_sk)
+        order by item_sk, qty
+    """))
+    df = sess._dfs["sales"]
+    thresh = df.groupby("item_sk")["qty"].mean() * 1.2
+    exp = df[df["qty"] > df["item_sk"].map(thresh)].sort_values(["item_sk", "qty"])
+    assert len(out) == len(exp)
+    assert list(out["qty"]) == list(exp["qty"])
+
+
+def test_scalar_subquery_uncorrelated(sess):
+    out = df_of(sess.sql(
+        "select count(*) c from sales where qty > (select avg(qty) from sales)"))
+    df = sess._dfs["sales"]
+    assert out["c"][0] == (df["qty"] > df["qty"].mean()).sum()
+
+
+def test_union_all_and_union(sess):
+    out = df_of(sess.sql("""
+        select item_sk from sales where qty > 18
+        union all
+        select item_sk from sales where qty > 18
+    """))
+    exp = sess._dfs["sales"].query("qty > 18")
+    assert len(out) == 2 * len(exp)
+    out2 = df_of(sess.sql("""
+        select item_sk from sales where qty > 18
+        union
+        select item_sk from sales where qty > 18
+    """))
+    assert len(out2) == exp["item_sk"].nunique()
+
+
+def test_intersect_except(sess):
+    out = df_of(sess.sql("""
+        select i_brand from item where i_category = 'Books'
+        intersect
+        select i_brand from item where i_category = 'Music'
+    """))
+    books = set(sess._dfs["item"].query("i_category == 'Books'")["i_brand"])
+    music = set(sess._dfs["item"].query("i_category == 'Music'")["i_brand"])
+    assert set(out["i_brand"]) == books & music
+    out2 = df_of(sess.sql("""
+        select i_brand from item
+        except
+        select i_brand from item where i_category = 'Books'
+    """))
+    allb = set(sess._dfs["item"]["i_brand"])
+    assert set(out2["i_brand"]) == allb - books
+
+
+def test_cte(sess):
+    out = df_of(sess.sql("""
+        with hot as (select item_sk, sum(qty) q from sales group by item_sk)
+        select i_brand, sum(q) bq from hot, item where item_sk = i_item_sk
+        group by i_brand order by i_brand
+    """))
+    df = sess._dfs["sales"].groupby("item_sk")["qty"].sum().reset_index()
+    df = df.merge(sess._dfs["item"], left_on="item_sk", right_on="i_item_sk")
+    exp = df.groupby("i_brand")["qty"].sum().reset_index().sort_values("i_brand")
+    assert list(out["i_brand"]) == list(exp["i_brand"])
+    assert list(out["bq"]) == list(exp["qty"])
+
+
+def test_window_rank_in_query(sess):
+    out = df_of(sess.sql("""
+        select * from (
+          select item_sk, qty,
+                 rank() over (partition by item_sk order by qty desc) rk
+          from sales) t
+        where rk = 1 and item_sk <= 5
+        order by item_sk, qty
+    """))
+    df = sess._dfs["sales"]
+    df = df[df["item_sk"] <= 5].copy()
+    df["rk"] = df.groupby("item_sk")["qty"].rank(method="min", ascending=False)
+    exp = df[df["rk"] == 1.0]
+    assert len(out) == len(exp)
+    for sk in exp["item_sk"].unique():
+        assert set(out[out["item_sk"] == sk]["qty"]) == \
+            set(exp[exp["item_sk"] == sk]["qty"])
+
+
+def test_rollup(sess):
+    out = df_of(sess.sql("""
+        select i_category, i_brand, sum(i_price) sp, grouping(i_brand) g
+        from item group by rollup(i_category, i_brand)
+        order by i_category nulls last, i_brand nulls last
+    """))
+    df = sess._dfs["item"].copy()
+    df["i_price"] = df["i_price"].astype(float)
+    lvl2 = df.groupby(["i_category", "i_brand"])["i_price"].sum()
+    lvl1 = df.groupby("i_category")["i_price"].sum()
+    total = df["i_price"].sum()
+    assert len(out) == len(lvl2) + len(lvl1) + 1
+    # grand total row: both keys null
+    gt = out[out["i_category"].isna() & out["i_brand"].isna()]
+    assert len(gt) == 1
+    np.testing.assert_allclose(float(gt["sp"].iloc[0]), total, rtol=1e-9)
+    assert int(gt["g"].iloc[0]) == 1
+    # subtotal rows
+    subs = out[out["i_category"].notna() & out["i_brand"].isna()]
+    for _, r in subs.iterrows():
+        np.testing.assert_allclose(float(r["sp"]), lvl1[r["i_category"]], rtol=1e-9)
+
+
+def test_between_and_decimal_filter(sess):
+    out = df_of(sess.sql(
+        "select count(*) c from sales where price between 50.00 and 60.00"))
+    df = sess._dfs["sales"]
+    p = df["price"].astype(float)
+    assert out["c"][0] == ((p >= 50.0) & (p <= 60.0)).sum()
+
+
+def test_null_handling_count(sess):
+    out = df_of(sess.sql(
+        "select count(*) a, count(cust_sk) b from sales"))
+    df = sess._dfs["sales"]
+    assert out["a"][0] == len(df)
+    assert out["b"][0] == df["cust_sk"].notna().sum()
+
+
+def test_left_join_sql(sess):
+    out = df_of(sess.sql("""
+        select c_cust_sk, count(cust_sk) n
+        from cust left join sales on cust_sk = c_cust_sk
+        group by c_cust_sk order by c_cust_sk
+    """))
+    df = sess._dfs["cust"].merge(sess._dfs["sales"], left_on="c_cust_sk",
+                                 right_on="cust_sk", how="left")
+    exp = df.groupby("c_cust_sk")["cust_sk"].count()
+    assert list(out["n"]) == list(exp.values)
