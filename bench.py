@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Driver benchmark: Power-Run geomean query time on the available chip.
+
+Generates raw data with the native generator, registers the tables, runs the
+supported TPC-DS query set through the engine (one warm-up pass for
+compilation, then one timed pass — the reference's Power Run times a warmed
+JVM the same way), and prints ONE JSON line:
+
+    {"metric": "power_geomean_ms", "value": N, "unit": "ms", "vs_baseline": N}
+
+The reference publishes no absolute numbers (BASELINE.md), so ``vs_baseline``
+is reported against this framework's own first recorded value when present
+(``.bench_baseline.json``), else 1.0.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+SCALE = os.environ.get("NDS_BENCH_SCALE", "0.05")
+CACHE = os.path.join(REPO, ".bench_cache", f"sf{SCALE}")
+NDSGEN = os.path.join(REPO, "native", "ndsgen", "ndsgen")
+
+
+def ensure_data():
+    if not os.path.exists(NDSGEN):
+        subprocess.run(["make", "-C", os.path.dirname(NDSGEN)], check=True,
+                       capture_output=True)
+    marker = os.path.join(CACHE, ".complete")
+    if not os.path.exists(marker):
+        os.makedirs(CACHE, exist_ok=True)
+        subprocess.run([NDSGEN, "-scale", SCALE, "-dir", CACHE], check=True)
+        open(marker, "w").close()
+    return CACHE
+
+
+def bench_queries():
+    """Supported query set: generated stream when present, else builtin q3."""
+    qdir = os.path.join(REPO, ".bench_cache", "stream")
+    try:
+        from nds_tpu.queries import generate_query_streams, SUPPORTED_QUERIES
+        from nds_tpu.power import gen_sql_from_stream
+        os.makedirs(qdir, exist_ok=True)
+        stream_file = os.path.join(qdir, "query_0.sql")
+        if not os.path.exists(stream_file):
+            generate_query_streams(qdir, streams=1, rngseed=0,
+                                   templates=SUPPORTED_QUERIES)
+        queries = gen_sql_from_stream(open(stream_file).read())
+        return list(queries.items())
+    except ImportError:
+        return [("query3", """
+            select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+                   sum(ss_ext_sales_price) sum_agg
+            from date_dim dt, store_sales, item
+            where dt.d_date_sk = store_sales.ss_sold_date_sk
+              and store_sales.ss_item_sk = item.i_item_sk
+              and item.i_manufact_id = 128
+              and dt.d_moy = 11
+            group by dt.d_year, item.i_brand_id, item.i_brand
+            order by dt.d_year, sum_agg desc, brand_id
+            limit 100
+        """)]
+
+
+def main():
+    data_dir = ensure_data()
+    from nds_tpu.engine.session import Session
+    from nds_tpu.schema import get_schemas
+
+    queries = bench_queries()
+    schemas = get_schemas(use_decimal=True)
+    sess = Session()
+    for table, fields in schemas.items():
+        path = os.path.join(data_dir, f"{table}.dat")
+        if os.path.exists(path):
+            sess.read_raw_view(table, path, fields)
+
+    times = {}
+    for _pass in ("warmup", "timed"):
+        for name, sql in queries:
+            t0 = time.perf_counter()
+            res = sess.sql(sql)
+            res.collect()
+            times[name] = (time.perf_counter() - t0) * 1000.0
+
+    geomean = math.exp(sum(math.log(max(t, 1e-3)) for t in times.values())
+                       / len(times))
+
+    baseline_file = os.path.join(REPO, ".bench_baseline.json")
+    vs = 1.0
+    if os.path.exists(baseline_file):
+        try:
+            base = json.load(open(baseline_file))
+            if base.get("value"):
+                vs = base["value"] / geomean
+        except (ValueError, KeyError):
+            pass
+    else:
+        json.dump({"metric": "power_geomean_ms", "value": geomean},
+                  open(baseline_file, "w"))
+
+    print(json.dumps({
+        "metric": "power_geomean_ms",
+        "value": round(geomean, 3),
+        "unit": "ms",
+        "vs_baseline": round(vs, 4),
+        "n_queries": len(times),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Multi-chip execution: device mesh, partitioned operators, ICI exchange."""
+
+from nds_tpu.parallel.exchange import (
+    all_to_all_exchange,
+    bucketize,
+    hash_partition_dest,
+    make_mesh,
+    sharded_filter_agg_step,
+)
+
+__all__ = [
+    "make_mesh",
+    "hash_partition_dest",
+    "bucketize",
+    "all_to_all_exchange",
+    "sharded_filter_agg_step",
+]

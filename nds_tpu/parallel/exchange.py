@@ -1,0 +1,147 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""ICI exchange: the TPU-native replacement for the network shuffle.
+
+The reference's accelerated stack moves shuffle data through the RAPIDS
+UCX shuffle manager between Spark executors (SURVEY.md §2.2 N4, §5.8). On a
+TPU pod the same role is played by XLA collectives over ICI: a fixed-capacity
+``all_to_all`` repartitions rows by key hash between chips (hash-exchange
+joins / aggregations), ``psum`` reduces partial aggregates (pre-aggregated
+group-by), and ``all_gather`` broadcasts build sides (broadcast joins).
+
+XLA requires static shapes, so the exchange uses capacity-bucketed send
+buffers: each device packs its rows into a ``(P, capacity)`` buffer slotted
+by destination device, with a validity plane marking real rows. Capacity is a
+planner choice (rows_per_device / P × slack); overflow is detectable via
+``bucket_overflow`` so the planner can re-run with a bigger capacity — the
+static-shape analog of a shuffle spill.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "part") -> Mesh:
+    """1-D device mesh over the row-partition axis.
+
+    Intra-query parallelism in the reference is Spark tasks over file splits
+    (SURVEY.md §2.4.1); here it is row shards over mesh devices, with ICI
+    collectives where Spark would shuffle.
+    """
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def hash_partition_dest(key: jnp.ndarray, n_parts: int) -> jnp.ndarray:
+    """Destination partition of each row: mix the key then mod P (the hash
+    exchange's partitioning function)."""
+    x = key.astype(jnp.uint64)
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> 31)
+    return (x % jnp.uint64(n_parts)).astype(jnp.int32)
+
+
+def bucketize(dest: jnp.ndarray, cols: dict, n_parts: int, capacity: int):
+    """Pack rows into per-destination send buffers.
+
+    Returns (buffers, valid, overflow): ``buffers[name]`` is ``(P, capacity)``
+    with rows grouped by destination, ``valid`` marks occupied slots, and
+    ``overflow`` counts rows dropped because a destination bucket was full
+    (0 on a correctly-capacity-planned run).
+    """
+    n = dest.shape[0]
+    order = jnp.argsort(dest)
+    sd = jnp.take(dest, order)
+    # slot of each row within its destination bucket
+    first = jnp.searchsorted(sd, sd, side="left")
+    pos = jnp.arange(n) - first
+    fits = pos < capacity
+    overflow = jnp.sum(~fits)
+    valid = jnp.zeros((n_parts, capacity), dtype=bool).at[sd, pos].set(
+        fits, mode="drop")
+    bufs = {}
+    for name, arr in cols.items():
+        v = jnp.take(arr, order)
+        buf = jnp.zeros((n_parts, capacity), dtype=arr.dtype).at[sd, pos].set(
+            jnp.where(fits, v, jnp.zeros((), dtype=arr.dtype)), mode="drop")
+        bufs[name] = buf
+    return bufs, valid, overflow
+
+
+def all_to_all_exchange(bufs: dict, valid: jnp.ndarray, axis: str = "part"):
+    """The ICI all-to-all: bucket j of every device lands on device j.
+
+    Inside ``shard_map`` only. After the exchange each device holds
+    ``(P, capacity)`` rows — one bucket from every peer — all sharing its key
+    range.
+    """
+    out = {name: jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)
+           for name, buf in bufs.items()}
+    vout = jax.lax.all_to_all(valid, axis, split_axis=0, concat_axis=0)
+    return out, vout
+
+
+def sharded_filter_agg_step(mesh: Mesh, num_groups: int, capacity: int,
+                            axis: str = "part"):
+    """Build the jitted partitioned filter→exchange→aggregate step.
+
+    The flagship distributed query step (the TPU analog of one Spark stage
+    pair around a hash exchange, ref: nds/power_run_gpu.template:29-30 shuffle
+    partition knobs): each device filters its row shard, repartitions
+    surviving rows by group-key hash over ICI, locally segment-aggregates its
+    key range, and a final ``psum`` of the group counts cross-checks that no
+    row was lost. Returns a function of sharded columns:
+
+        (group_key i32[N], qty i64[N], sold i32[N], lo, hi)
+            -> (sums i64[G_local per device], counts i64[G], total i64)
+    """
+    n_parts = mesh.devices.size
+
+    def local_step(group_key, qty, sold, lo, hi):
+        # filter: NULL-free predicate on the date column (masked rows keep
+        # slot but zero weight — static shapes, no compaction)
+        keep = (sold >= lo) & (sold <= hi)
+        dest = hash_partition_dest(group_key.astype(jnp.uint64), n_parts)
+        # dead rows all route to bucket of key 0 with zero weight; cheaper is
+        # keeping them in place with weight 0 so buckets stay balanced
+        w = jnp.where(keep, qty, jnp.zeros((), dtype=qty.dtype))
+        bufs, valid, _ = bucketize(
+            dest, {"key": group_key, "w": w}, n_parts, capacity)
+        ex, vex = all_to_all_exchange(bufs, valid, axis)
+        keys = ex["key"].reshape(-1)
+        wts = ex["w"].reshape(-1)
+        vflat = vex.reshape(-1)
+        # this device owns group ids g with hash(g)%P == my index; segment-sum
+        # over the full group-id space, zero elsewhere
+        gids = jnp.clip(keys, 0, num_groups - 1)
+        w_live = jnp.where(vflat, wts, jnp.zeros((), dtype=wts.dtype))
+        sums = jax.ops.segment_sum(w_live, gids, num_segments=num_groups)
+        ones = jnp.where(vflat, jnp.ones_like(wts), jnp.zeros_like(wts))
+        counts_local = jax.ops.segment_sum(ones, gids, num_segments=num_groups)
+        counts = jax.lax.psum(counts_local, axis)
+        total = jax.lax.psum(jnp.sum(w_live), axis)
+        return sums, counts, total
+
+    try:
+        from jax import shard_map
+        rep_kw = {"check_vma": False}
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+        rep_kw = {"check_rep": False}
+
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P()),
+        out_specs=(P(axis), P(), P()),
+        **rep_kw)
+    in_shardings = (
+        NamedSharding(mesh, P(axis)), NamedSharding(mesh, P(axis)),
+        NamedSharding(mesh, P(axis)), NamedSharding(mesh, P()),
+        NamedSharding(mesh, P()))
+    return jax.jit(sharded, in_shardings=in_shardings)
