@@ -108,7 +108,11 @@ def arith(op: str, a: Column, b: Column) -> Column:
         if op == "%":
             da, db = a.data.astype(jnp.int64), b.data.astype(jnp.int64)
             zero = db == 0
-            out = jnp.where(zero, 0, da % jnp.where(zero, 1, db))
+            safe_db = jnp.where(zero, 1, db)
+            out = jnp.where(zero, 0, da % safe_db)
+            # SQL/Spark remainder takes the dividend's sign, not the divisor's
+            out = jnp.where((out != 0) & ((out < 0) != (da < 0)),
+                            out - safe_db, out)
             v = valid if valid is not None else jnp.ones(len(a), dtype=bool)
             return Column("i64", out, v & ~zero)
     # float path
@@ -121,7 +125,8 @@ def arith(op: str, a: Column, b: Column) -> Column:
         out = fa * fb
     elif op == "%":
         zero = fb == 0
-        out = jnp.where(zero, 0.0, jnp.mod(fa, jnp.where(zero, 1.0, fb)))
+        # fmod (C semantics: dividend's sign) matches Spark's % on doubles
+        out = jnp.where(zero, 0.0, jnp.fmod(fa, jnp.where(zero, 1.0, fb)))
         v = valid if valid is not None else jnp.ones(len(a), dtype=bool)
         return Column("f64", out, v & ~zero)
     else:
@@ -233,6 +238,12 @@ def _unify(cols):
         return fixed, "i64"
     fixed = [Column("f64", _as_f64(c), c.valid) for c in cols]
     return fixed, "f64"
+
+
+def unify_columns(cols):
+    """Public alias of :func:`_unify` for cross-module use (set operations
+    align operand columns with it)."""
+    return _unify(cols)
 
 
 def case_when(branches, else_col: Column) -> Column:

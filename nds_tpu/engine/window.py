@@ -124,25 +124,82 @@ class WindowContext:
             return self._scatter(per_row, kind, valid_sorted=(c > 0)[self.gid_sorted])
         raise ValueError(f"unsupported window aggregate: {agg}")
 
-    def running_sum(self, col: Column) -> Column:
-        """sum() over (partition ... order ... rows unbounded preceding)."""
+    def _segmented_scan(self, vals: jnp.ndarray, op: str) -> jnp.ndarray:
+        """Inclusive scan of ``vals`` (already in sorted order) that resets at
+        partition boundaries. Classic segmented-scan formulation over
+        (reset-flag, value) pairs — associative, so it runs as one
+        ``associative_scan`` on device."""
+        flags = self.part_boundary
+
+        if op == "sum":
+            def combine(a, b):
+                fa, va = a
+                fb, vb = b
+                return fa | fb, jnp.where(fb, vb, va + vb)
+        elif op == "min":
+            def combine(a, b):
+                fa, va = a
+                fb, vb = b
+                return fa | fb, jnp.where(fb, vb, jnp.minimum(va, vb))
+        elif op == "max":
+            def combine(a, b):
+                fa, va = a
+                fb, vb = b
+                return fa | fb, jnp.where(fb, vb, jnp.maximum(va, vb))
+        else:
+            raise ValueError(op)
+        _, out = jax.lax.associative_scan(combine, (flags, vals))
+        return out
+
+    def _range_extend(self, run: jnp.ndarray) -> jnp.ndarray:
+        """RANGE frames include order-key peers: every row takes the scan
+        value of the LAST row of its peer run."""
+        rid = jnp.cumsum(self.order_boundary) - 1
+        nruns = int(rid[-1]) + 1 if self.n else 0
+        last_pos = jax.ops.segment_max(self.pos, rid, num_segments=nruns)
+        return jnp.take(run, jnp.take(last_pos, rid))
+
+    def running_agg(self, col: Column, agg: str, rows_frame: bool = False) -> Column:
+        """sum/count/avg/min/max over (partition ... order ... unbounded
+        preceding .. current row). ``rows_frame`` selects ROWS semantics;
+        the SQL default frame is RANGE (order-key peers included)."""
         valid = jnp.take(col.valid_mask(), self.order)
         data = jnp.take(col.data, self.order)
-        f = data.astype(jnp.float64) if col.kind == "f64" else data.astype(jnp.int64)
-        f = jnp.where(valid, f, 0)
-        c = jnp.cumsum(f)
-        # subtract the cumsum just before each segment start; exactly one
-        # nonzero candidate per segment, so segment_sum extracts it (works for
-        # negative running sums where a max would not)
-        c_before = jnp.where(self.part_boundary, c - f, 0)
-        off = jax.ops.segment_sum(c_before, self.gid_sorted,
-                                  num_segments=self.ngroups)[self.gid_sorted]
-        run = c - off
-        vcount = jnp.cumsum(valid.astype(jnp.int64))
-        v_before = jnp.where(self.part_boundary, vcount - valid.astype(jnp.int64), 0)
-        voff = jax.ops.segment_max(v_before, self.gid_sorted,
-                                   num_segments=self.ngroups)[self.gid_sorted]
-        has_any = (vcount - voff) > 0
-        kind = ("f64" if col.kind == "f64"
-                else (f"dec(38,{col.scale})" if is_dec(col.kind) else "i64"))
-        return self._scatter(run, kind, valid_sorted=has_any)
+        is_f = col.kind == "f64"
+        f = data.astype(jnp.float64) if is_f else data.astype(jnp.int64)
+
+        vcount = self._segmented_scan(valid.astype(jnp.int64), "sum")
+        if not rows_frame:
+            vcount = self._range_extend(vcount)
+        has_any = vcount > 0
+
+        if agg == "count":
+            return self._scatter(vcount, "i64")
+        if agg in ("sum", "avg"):
+            run = self._segmented_scan(jnp.where(valid, f, 0), "sum")
+            if not rows_frame:
+                run = self._range_extend(run)
+            if agg == "avg":
+                sf = run.astype(jnp.float64)
+                if is_dec(col.kind):
+                    sf = sf / (10.0 ** col.scale)
+                return self._scatter(sf / jnp.maximum(vcount, 1), "f64",
+                                     valid_sorted=has_any)
+            kind = ("f64" if is_f
+                    else (f"dec(38,{col.scale})" if is_dec(col.kind) else "i64"))
+            return self._scatter(run, kind, valid_sorted=has_any)
+        if agg in ("min", "max"):
+            big = jnp.inf if is_f else jnp.iinfo(jnp.int64).max
+            sent = -big if agg == "max" else big
+            run = self._segmented_scan(jnp.where(valid, f, sent), agg)
+            if not rows_frame:
+                run = self._range_extend(run)
+            kind = "f64" if is_f else (col.kind if is_dec(col.kind) else "i64")
+            if not is_f:
+                run = run.astype(jnp.int64)
+            return self._scatter(run, kind, valid_sorted=has_any)
+        raise ValueError(f"unsupported running aggregate: {agg}")
+
+    def running_sum(self, col: Column) -> Column:
+        """Back-compat alias: ROWS-frame running sum."""
+        return self.running_agg(col, "sum", rows_frame=True)

@@ -136,7 +136,9 @@ class FuncCall(Expr):
 class WindowSpec:
     partition_by: list
     order_by: list                   # [(expr, desc, nulls_last)]
-    frame: Optional[str] = None      # 'rows_unbounded_preceding' | None (=full)
+    frame: Optional[str] = None      # '{rows,range}_unbounded_preceding' |
+                                     # None (= SQL default: RANGE..CURRENT ROW
+                                     # with ORDER BY, full partition without)
 
 
 @dataclass

@@ -612,19 +612,24 @@ class Parser:
                     order.append((e, desc, nulls_last))
                     if not self.accept_op(","):
                         break
-            if self.accept_kw("rows", "range"):
-                # the corpus uses ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW
+            frame_kw = None
+            if self.accept_kw("rows"):
+                frame_kw = "rows"
+            elif self.accept_kw("range"):
+                frame_kw = "range"
+            if frame_kw:
+                # the corpus uses [ROWS|RANGE] BETWEEN UNBOUNDED PRECEDING
+                # AND CURRENT ROW (ROWS and RANGE differ on order-key ties)
                 if self.accept_kw("between"):
                     self.expect_kw("unbounded")
                     self.expect_kw("preceding")
                     self.expect_kw("and")
                     self.expect_kw("current")
                     self.expect_kw("row")
-                    frame = "rows_unbounded_preceding"
                 else:
                     self.expect_kw("unbounded")
                     self.expect_kw("preceding")
-                    frame = "rows_unbounded_preceding"
+                frame = f"{frame_kw}_unbounded_preceding"
             self.expect_op(")")
             return A.WindowFunc(fc, A.WindowSpec(partition, order, frame))
         return fc

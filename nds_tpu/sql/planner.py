@@ -105,6 +105,15 @@ class Planner:
             right = DeviceTable(
                 {ln: right[rn] for ln, rn in zip(left.column_names, right.column_names)},
                 right.nrows)
+            # unify each positional pair onto one physical kind (a dec(7,2)
+            # column and a literal 0 have different representations; blind
+            # concatenation would corrupt values)
+            lu, ru = {}, {}
+            for name in left.column_names:
+                (lc, rc), _ = X.unify_columns([left[name], right[name]])
+                lu[name], ru[name] = lc, rc
+            left = DeviceTable(lu, left.nrows)
+            right = DeviceTable(ru, right.nrows)
             if body.op == "union_all":
                 return E.concat_tables([left, right])
             if body.op == "union":
@@ -237,7 +246,20 @@ class Planner:
                 raise ExecError("semi/anti join requires equi condition")
             lkeys = [left[l] for l, _ in equi]
             rkeys = [right[r] for _, r in equi]
-            mask = E.semi_join_mask(lkeys, rkeys, negate=(kind == "anti"))
+            if residual:
+                # a left row matches only if some equi-matching right row also
+                # satisfies the residual conjuncts
+                l_idx, r_idx, _, _ = E.join_indices(lkeys, rkeys, "inner")
+                pair_cols = {n: c.take(l_idx) for n, c in left.columns.items()}
+                pair_cols.update(
+                    {n: c.take(r_idx) for n, c in right.columns.items()})
+                pairs = DeviceTable(pair_cols, int(l_idx.shape[0]))
+                ok = self._conjunct_mask(pairs, residual)
+                hit = jnp.take(l_idx, jnp.nonzero(ok)[0])
+                matched = jnp.zeros(left.nrows, dtype=bool).at[hit].set(True)
+            else:
+                matched = E.semi_join_mask(lkeys, rkeys)
+            mask = ~matched if kind == "anti" else matched
             return left.take(jnp.nonzero(mask)[0])
         if not equi:
             # pure cartesian with optional residual filter
@@ -668,9 +690,14 @@ class Planner:
             elif fname in ("sum", "avg", "min", "max", "count"):
                 arg = (self.eval_expr(w.func.args[0], ctx) if w.func.args
                        else Column("i64", jnp.ones(ctx.table.nrows, dtype=jnp.int64)))
-                if w.spec.frame == "rows_unbounded_preceding" and fname == "sum" \
-                        and w.spec.order_by:
-                    col = wc.running_sum(arg)
+                frame = w.spec.frame
+                if frame is None and w.spec.order_by:
+                    # SQL default with ORDER BY: RANGE UNBOUNDED PRECEDING ..
+                    # CURRENT ROW (a running, not whole-partition, aggregate)
+                    frame = "range_unbounded_preceding"
+                if frame is not None and w.spec.order_by:
+                    col = wc.running_agg(arg, fname,
+                                         rows_frame=frame.startswith("rows"))
                 else:
                     col = wc.partition_agg(arg, fname)
             else:
@@ -854,18 +881,29 @@ class Planner:
             return Column("bool", jnp.zeros(len(col), dtype=bool))
         if col.kind == "str":
             res = X.fn_in_strings(col, [str(v) for v in values])
+        elif col.kind == "f64":
+            data = jnp.isin(col.data, jnp.asarray(
+                [float(v) for v in values], dtype=jnp.float64))
+            res = Column("bool", data, col.valid)
         else:
+            from decimal import Decimal
             scale = col.scale
             nums = []
             for v in values:
-                if type(v).__name__ == "Decimal":
-                    nums.append(int(v.scaleb(scale)))
-                elif isinstance(v, (int, float)):
-                    nums.append(int(round(v * (10 ** scale))))
-                else:
-                    raise ExecError(f"bad IN-list literal {v!r}")
-            data = jnp.isin(col.data, jnp.asarray(nums, dtype=jnp.int64))
-            res = Column("bool", data, col.valid)
+                if not isinstance(v, Decimal):
+                    if not isinstance(v, (int, float)):
+                        raise ExecError(f"bad IN-list literal {v!r}")
+                    v = Decimal(str(v))
+                scaled = v.scaleb(scale)
+                # a literal that is fractional at this column's scale can
+                # never match an int/decimal column — drop it, don't round
+                if scaled == scaled.to_integral_value():
+                    nums.append(int(scaled))
+            if not nums:
+                res = Column("bool", jnp.zeros(len(col), dtype=bool), col.valid)
+            else:
+                data = jnp.isin(col.data, jnp.asarray(nums, dtype=jnp.int64))
+                res = Column("bool", data, col.valid)
         return X.logical_not(res) if e.negated else res
 
     def _eval_case(self, e: A.Case, ctx: EvalCtx) -> Column:
@@ -901,7 +939,6 @@ class Planner:
             a = self.eval_expr(e.args[0], ctx)
             b = self.eval_expr(e.args[1], ctx)
             eq = X.compare("=", a, b)
-            neq_or_null = X.logical_not(eq)
             new_valid = a.valid_mask() & ~(eq.data.astype(bool) & eq.valid_mask())
             return Column(a.kind, a.data, new_valid, a.dict_values)
         if name in ("abs",):
@@ -1090,8 +1127,19 @@ class Planner:
         for lc, rc in zip(lcols, rcols):
             lc2, _ = self._coerce_pair(lc, rc)
             lcols2.append(lc2)
-        mask = E.semi_join_mask(lcols2, rcols, negate=e.negated)
-        return Column("bool", mask)
+        mask = E.semi_join_mask(lcols2, rcols)
+        if not e.negated:
+            return Column("bool", mask)
+        # ANSI NOT IN per correlation group: a NULL lhs, or any NULL value in
+        # the row's matching group, makes the predicate NULL (never true)
+        keep = ~mask & lcols2[0].valid_mask()
+        val_col = rcols[0]
+        if val_col.null_count() > 0:
+            null_rows = jnp.nonzero(~val_col.valid_mask())[0]
+            null_keys = [c.take(null_rows) for c in rcols[1:]]
+            group_has_null = E.semi_join_mask(lcols2[1:], null_keys)
+            keep = keep & ~group_has_null
+        return Column("bool", keep)
 
     def _eval_scalar_subquery(self, e: A.ScalarSubquery, ctx: EvalCtx) -> Column:
         n = ctx.table.nrows
@@ -1149,12 +1197,24 @@ class Planner:
             val = e.quantifier == "all"
             return Column("bool", jnp.full(n, val, dtype=bool))
         gids = jnp.zeros(rt.nrows, dtype=jnp.int64)
+
+        def broadcast(red):
+            return Column(red.kind, jnp.broadcast_to(red.data[0], (n,)),
+                          None if red.valid is None
+                          else jnp.broadcast_to(red.valid[0], (n,)),
+                          red.dict_values)
+
+        if e.op in ("=", "<>"):
+            # = ALL: every value equals lhs  <=>  min = lhs AND max = lhs
+            # <> ANY: some value differs     <=>  NOT (= ALL)
+            mn = broadcast(E.agg_min(col, gids, 1))
+            mx = broadcast(E.agg_min(col, gids, 1, is_max=True))
+            all_eq = X.logical_and(X.compare("=", lhs, mn),
+                                   X.compare("=", lhs, mx))
+            return all_eq if e.op == "=" else X.logical_not(all_eq)
         use_max = (e.op in (">", ">=")) == (e.quantifier == "all") or \
                   (e.op in ("<", "<=") and e.quantifier == "any")
-        red = E.agg_min(col, gids, 1, is_max=use_max)
-        scalar = Column(red.kind, jnp.broadcast_to(red.data[0], (n,)),
-                        None if red.valid is None else jnp.broadcast_to(red.valid[0], (n,)),
-                        red.dict_values)
+        scalar = broadcast(E.agg_min(col, gids, 1, is_max=use_max))
         return X.compare(e.op, lhs, scalar)
 
 

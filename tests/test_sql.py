@@ -286,3 +286,99 @@ def test_left_join_sql(sess):
                                  right_on="cust_sk", how="left")
     exp = df.groupby("c_cust_sk")["cust_sk"].count()
     assert list(out["n"]) == list(exp.values)
+
+
+def test_union_all_unifies_decimal_and_literal(sess):
+    """A dec(7,2) column unioned with literal 0 must not reinterpret the
+    literal as fixed-point 0.50-style garbage (review finding: set-op
+    positional alignment without type unification)."""
+    out = df_of(sess.sql("""
+        select price v from sales where item_sk = 1
+        union all
+        select 50 from sales where item_sk = 2
+    """))
+    df = sess._dfs["sales"]
+    n2 = len(df.query("item_sk == 2"))
+    fifty = out["v"].astype(float).eq(50.0).sum()
+    assert fifty == n2
+
+
+def test_window_default_frame_is_running(sess):
+    out = df_of(sess.sql("""
+        select item_sk, qty, sold_date,
+               sum(qty) over (partition by item_sk order by sold_date) rs
+        from sales where item_sk = 3
+    """))
+    df = sess._dfs["sales"].query("item_sk == 3").copy()
+    # SQL default frame is RANGE: ties on sold_date share the running value
+    g = df.groupby("sold_date")["qty"].sum().sort_index().cumsum()
+    exp = df["sold_date"].map(g)
+    got = out.set_index(out.index)["rs"].astype(int)
+    merged = out.copy()
+    merged["exp"] = merged["sold_date"].map(g)
+    assert (merged["rs"].astype(int) == merged["exp"].astype(int)).all()
+
+
+def test_window_rows_frame_running_max(sess):
+    out = df_of(sess.sql("""
+        select item_sk, qty, sold_date,
+               max(qty) over (partition by item_sk order by sold_date, qty
+                              rows between unbounded preceding and current row) rm
+        from sales where item_sk <= 2
+    """))
+    df = sess._dfs["sales"].query("item_sk <= 2").copy()
+    df = df.sort_values(["item_sk", "sold_date", "qty"], kind="stable")
+    df["rm"] = df.groupby("item_sk")["qty"].cummax()
+    key = ["item_sk", "sold_date", "qty"]
+    got = out.sort_values(key, kind="stable")["rm"].astype(int).tolist()
+    assert got == df["rm"].astype(int).tolist()
+
+
+def test_modulo_dividend_sign(sess):
+    out = df_of(sess.sql(
+        "select (0 - qty) % 3 m from sales where item_sk = 1 and qty = 7"))
+    if len(out):
+        assert set(out["m"]) == {-1}
+
+
+def test_in_list_fractional_literal_on_int_column(sess):
+    out = df_of(sess.sql("select qty from sales where qty in (1.5, 3)"))
+    assert set(out["qty"]) == {3}
+
+
+def test_not_in_correlated_with_nulls(sess):
+    # cust_sk has nulls; x NOT IN (corr subquery) must drop NULL-lhs rows
+    out = df_of(sess.sql("""
+        select s.item_sk, s.cust_sk from sales s
+        where s.cust_sk not in
+            (select s2.cust_sk from sales s2 where s2.item_sk = s.item_sk
+             and s2.qty > 100)
+    """))
+    assert out["cust_sk"].notna().all()
+
+
+def test_quantified_eq_all(sess):
+    # = ALL over a single-value set behaves as equality; over a multi-value
+    # set it is false for every row
+    out = df_of(sess.sql("""
+        select qty from sales
+        where qty = all (select 5)
+    """))
+    assert set(out["qty"]) <= {5}
+    out2 = df_of(sess.sql("""
+        select count(*) c from sales
+        where qty = all (select distinct qty from sales where qty in (4, 5))
+    """))
+    assert int(out2["c"].iloc[0]) == 0
+
+
+def test_semi_join_residual_condition(sess):
+    out = df_of(sess.sql("""
+        select s.item_sk, s.qty from sales s
+        left semi join item i on s.item_sk = i.i_item_sk
+            and i.i_category = 'Books'
+    """))
+    books = set(sess._dfs["item"].query("i_category == 'Books'")["i_item_sk"])
+    assert set(out["item_sk"]) <= books
+    exp = sess._dfs["sales"][sess._dfs["sales"]["item_sk"].isin(books)]
+    assert len(out) == len(exp)
