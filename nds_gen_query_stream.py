@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Query-stream generation driver.
+
+TPU-build equivalent of the reference stream-gen CLI (ref:
+nds/nds_gen_query_stream.py): emits one specific query (--template) or N
+permuted 99-query streams (--streams) in dsqgen's output format, using the
+packaged Spark-dialect templates in nds_tpu/queries/templates (the role the
+user-downloaded TPC-DS toolkit's query_templates + templates.lst play for
+the reference).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from nds_tpu.check import check_version, get_abs_path  # noqa: E402
+from nds_tpu.queries import TEMPLATE_DIR, generate_query_streams  # noqa: E402
+
+check_version()
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("template_dir",
+                        nargs="?",
+                        default=TEMPLATE_DIR,
+                        help="directory to find query templates; defaults to "
+                        "the packaged template corpus.")
+    parser.add_argument("scale",
+                        help="assume a database of this scale factor.")
+    parser.add_argument("output_dir",
+                        help="generate query stream(s) in this directory.")
+    group = parser.add_mutually_exclusive_group(required=True)
+    group.add_argument("--template",
+                       help="generate a specific query from a template, e.g. "
+                       "'query3.tpl'. Note: query14/23/24/39 contain two "
+                       "queries and are written as _part1/_part2 files.")
+    group.add_argument("--streams",
+                       help="generate how many query streams.")
+    parser.add_argument("--rngseed",
+                        help="seed the random generation of the queries.")
+    args = parser.parse_args()
+
+    if args.template_dir != TEMPLATE_DIR:
+        import nds_tpu.queries as q
+        q.TEMPLATE_DIR = get_abs_path(args.template_dir)
+    generate_query_streams(
+        get_abs_path(args.output_dir),
+        streams=int(args.streams) if args.streams else None,
+        template=args.template,
+        rngseed=int(args.rngseed) if args.rngseed else None)

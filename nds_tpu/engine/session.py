@@ -55,6 +55,10 @@ class Session:
         self.catalog: dict[str, DeviceTable] = {}
         self.warehouse = None            # attached by maintenance driver
         self.view_setup_times: list = [] # (name, ms) like setup_tables timing
+        # the role Spark's applicationId plays in time logs
+        # (ref: nds/nds_power.py:246,265)
+        self.app_id = f"nds-tpu-{int(time.time() * 1000)}"
+        self.app_name = "nds-tpu"
 
     # -- catalog ------------------------------------------------------------
 

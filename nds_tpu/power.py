@@ -1,0 +1,239 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Power Run core: stream parsing, table registration, the query loop.
+
+TPU-native equivalent of the reference Power Run driver library
+(ref: nds/nds_power.py). The hot loop holds the same contract: every query
+runs under a BenchReport (JSON summary + status taxonomy), per-query times
+land in a CSV time log (header ``application_id,query,time/milliseconds``,
+ref: nds/nds_power.py:294-303), and the process exits non-zero when any
+query failed or completed with task failures (ref: nds/nds_power.py:310-322).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import sys
+import time
+from collections import OrderedDict
+
+from nds_tpu.check import check_json_summary_folder, check_query_subset_exists
+from nds_tpu.queries import split_special_query
+from nds_tpu.report import BenchReport
+from nds_tpu.schema import get_schemas
+
+
+def gen_sql_from_stream(query_stream_file_path: str) -> "OrderedDict[str, str]":
+    """Split a generated query stream into an ordered {name: sql} dict,
+    splitting the two-statement queries 14/23/24/39 into _part1/_part2
+    (same parse as ref: nds/nds_power.py:50-77)."""
+    with open(query_stream_file_path) as f:
+        stream = f.read()
+    all_queries = stream.split("-- start")[1:]
+    extended = OrderedDict()
+    for q in all_queries:
+        query_name = q[q.find("template") + 9: q.find(".tpl")]
+        if "select" in q.split(";")[1]:
+            part_1, part_2 = split_special_query(q)
+            extended[query_name + "_part1"] = part_1
+            extended[query_name + "_part2"] = part_2
+        else:
+            extended[query_name] = q
+    for name, content in extended.items():
+        extended[name] = "-- start" + content
+    return extended
+
+
+def get_query_subset(query_dict: "OrderedDict", subset) -> "OrderedDict":
+    """Select a subset of queries from the stream, preserving order
+    (ref: nds/nds_power.py:177-182)."""
+    check_query_subset_exists(query_dict, subset)
+    return OrderedDict((name, query_dict[name]) for name in subset)
+
+
+def strip_stream_markers(sql: str) -> str:
+    """Remove the '-- start/-- end' marker lines and trailing ';' so the
+    bare statement can be handed to the engine parser."""
+    lines = [ln for ln in sql.splitlines()
+             if not ln.strip().startswith("-- start")
+             and not ln.strip().startswith("-- end")]
+    text = "\n".join(lines).strip()
+    if text.endswith(";"):
+        text = text[:-1]
+    return text
+
+
+def setup_tables(session, input_prefix: str, input_format: str,
+                 use_decimal: bool, execution_time_list: list) -> list:
+    """Register the 24 source tables as engine views, timing each
+    registration (ref: nds/nds_power.py:79-106)."""
+    schemas = get_schemas(use_decimal=use_decimal)
+    for table_name, fields in schemas.items():
+        start = time.time()
+        if input_format in ("csv", "raw"):
+            path = os.path.join(input_prefix, f"{table_name}.dat")
+            if not os.path.exists(path):
+                path = os.path.join(input_prefix, table_name)
+            session.read_raw_view(table_name, path, fields)
+        else:
+            path = os.path.join(input_prefix, table_name)
+            canonical = {f.name: str(f.type) for f in fields}
+            session.read_columnar_view(table_name, path, input_format,
+                                       canonical)
+        end = time.time()
+        print(f"====== Creating TempView for table {table_name} ======")
+        print(f"Time taken: {end - start} s for table {table_name}")
+        execution_time_list.append(
+            (session.app_id, f"CreateTempView {table_name}",
+             int((end - start) * 1000)))
+    return execution_time_list
+
+
+def ensure_valid_column_names(result):
+    """The reference rewrites invalid parquet column names before writing
+    (ref: nds/nds_power.py:137-174); our writer quotes arbitrary names, so
+    only spec-format backtick-quoted aggregates need renaming."""
+    import re
+    arrow = result.to_arrow()
+    renames = {}
+    for name in arrow.column_names:
+        clean = re.sub(r"[ ,;{}()\n\t=]", "_", name)
+        if clean != name:
+            renames[name] = clean
+    if renames:
+        arrow = arrow.rename_columns(
+            [renames.get(n, n) for n in arrow.column_names])
+    return arrow
+
+
+def run_one_query(session, query: str, query_name: str,
+                  output_path: str | None, output_format: str) -> None:
+    """Execute one query; collect() to host or write to the output prefix
+    (ref: nds/nds_power.py:125-135)."""
+    result = session.sql(strip_stream_markers(query))
+    if not output_path:
+        result.collect()
+    else:
+        from nds_tpu.io.columnar import write_table
+        write_table(ensure_valid_column_names(result),
+                    os.path.join(output_path, query_name), output_format)
+
+
+def run_query_stream(input_prefix: str,
+                     property_file: str | None,
+                     query_dict: "OrderedDict",
+                     time_log_output_path: str,
+                     extra_time_log_output_path: str | None = None,
+                     sub_queries=None,
+                     input_format: str = "parquet",
+                     use_decimal: bool = True,
+                     output_path: str | None = None,
+                     output_format: str = "parquet",
+                     json_summary_folder: str | None = None,
+                     allow_failure: bool = False,
+                     warehouse_type: str | None = None) -> None:
+    """The Power Run loop (ref: nds/nds_power.py:184-322)."""
+    from nds_tpu.engine.session import Session
+
+    queries_reports = []
+    execution_time_list: list = []
+    total_time_start = time.time()
+    if len(query_dict) == 1:
+        app_name = "NDS - " + list(query_dict.keys())[0]
+    else:
+        app_name = "NDS - Power Run"
+
+    conf = load_properties(property_file) if property_file else {}
+    session = Session(conf)
+    session.app_name = app_name
+    if input_format in ("iceberg", "delta") or warehouse_type:
+        # warehouse-backed tables: input_prefix is the warehouse root
+        from nds_tpu.warehouse import Warehouse
+        wh = Warehouse(input_prefix)
+        session.warehouse = wh
+        for table_name in wh.table_names():
+            from nds_tpu.engine.column import from_arrow
+            start = time.time()
+            session.create_temp_view(table_name, wh.read(table_name))
+            execution_time_list.append(
+                (session.app_id, f"CreateTempView {table_name}",
+                 int((time.time() - start) * 1000)))
+    else:
+        execution_time_list = setup_tables(
+            session, input_prefix, input_format, use_decimal,
+            execution_time_list)
+
+    check_json_summary_folder(json_summary_folder)
+    if sub_queries:
+        query_dict = get_query_subset(query_dict, sub_queries)
+
+    power_start = int(time.time())
+    for query_name, q_content in query_dict.items():
+        print(f"====== Run {query_name} ======")
+        q_report = BenchReport(session)
+        elapsed = q_report.report_on(run_one_query, session, q_content,
+                                     query_name, output_path, output_format)
+        print(f"Time taken: [{elapsed}] millis for {query_name}")
+        execution_time_list.append((session.app_id, query_name, elapsed))
+        q_report.summary["query"] = query_name
+        queries_reports.append(q_report)
+        if json_summary_folder:
+            if property_file:
+                summary_prefix = os.path.join(
+                    json_summary_folder,
+                    os.path.basename(property_file).split(".")[0])
+            else:
+                summary_prefix = os.path.join(json_summary_folder, "")
+            q_report.write_summary(query_name, prefix=summary_prefix)
+    power_end = int(time.time())
+    power_elapse = int((power_end - power_start) * 1000)
+    total_elapse = int((time.time() - total_time_start) * 1000)
+    print(f"====== Power Test Time: {power_elapse} milliseconds ======")
+    print(f"====== Total Time: {total_elapse} milliseconds ======")
+    execution_time_list.append((session.app_id, "Power Start Time", power_start))
+    execution_time_list.append((session.app_id, "Power End Time", power_end))
+    execution_time_list.append((session.app_id, "Power Test Time", power_elapse))
+    execution_time_list.append((session.app_id, "Total Time", total_elapse))
+
+    header = ["application_id", "query", "time/milliseconds"]
+    print(header)
+    for row in execution_time_list:
+        print(row)
+    if time_log_output_path:
+        with open(time_log_output_path, "w", encoding="UTF8") as f:
+            writer = csv.writer(f)
+            writer.writerow(header)
+            writer.writerows(execution_time_list)
+    if extra_time_log_output_path:
+        os.makedirs(extra_time_log_output_path, exist_ok=True)
+        with open(os.path.join(extra_time_log_output_path, "part-0.csv"),
+                  "w", encoding="UTF8") as f:
+            writer = csv.writer(f)
+            writer.writerow(header)
+            writer.writerows(execution_time_list)
+
+    exit_code = 0
+    for q in queries_reports:
+        if not q.is_success():
+            if exit_code == 0:
+                print("====== Queries with failure ======")
+            print("{} status: {}".format(q.summary["query"],
+                                         q.summary["queryStatus"]))
+            exit_code = 1
+    if exit_code:
+        print("Above queries failed or completed with failed tasks. "
+              "Please check the logs for the detailed reason.")
+    if not allow_failure and exit_code:
+        sys.exit(exit_code)
+
+
+def load_properties(filename: str) -> dict:
+    """java-properties overlay file -> dict (ref: nds/nds_power.py:324-330)."""
+    myvars = {}
+    with open(filename) as myfile:
+        for line in myfile:
+            if line.strip().startswith("#") or "=" not in line:
+                continue
+            name, var = line.partition("=")[::2]
+            myvars[name.strip()] = var.strip()
+    return myvars

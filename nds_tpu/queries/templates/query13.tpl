@@ -1,0 +1,52 @@
+--@ YEAR = uniform(1998, 2002)
+--@ MS1 = pool(marital)
+--@ MS2 = pool(marital)
+--@ MS3 = pool(marital)
+--@ ES1 = pool(education)
+--@ ES2 = pool(education)
+--@ ES3 = pool(education)
+--@ STATE1 = sample(3, state)
+--@ STATE2 = sample(3, state)
+--@ STATE3 = sample(3, state)
+select avg(ss_quantity),
+       avg(ss_ext_sales_price),
+       avg(ss_ext_wholesale_cost),
+       sum(ss_ext_wholesale_cost)
+from store_sales,
+     store,
+     customer_demographics,
+     household_demographics,
+     customer_address,
+     date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk and d_year = [YEAR]
+  and ((ss_hdemo_sk = hd_demo_sk
+        and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = '[MS1]'
+        and cd_education_status = '[ES1]'
+        and ss_sales_price between 100.00 and 150.00
+        and hd_dep_count = 3)
+    or (ss_hdemo_sk = hd_demo_sk
+        and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = '[MS2]'
+        and cd_education_status = '[ES2]'
+        and ss_sales_price between 50.00 and 100.00
+        and hd_dep_count = 1)
+    or (ss_hdemo_sk = hd_demo_sk
+        and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = '[MS3]'
+        and cd_education_status = '[ES3]'
+        and ss_sales_price between 150.00 and 200.00
+        and hd_dep_count = 1))
+  and ((ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('[STATE1.1]', '[STATE1.2]', '[STATE1.3]')
+        and ss_net_profit between 100 and 200)
+    or (ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('[STATE2.1]', '[STATE2.2]', '[STATE2.3]')
+        and ss_net_profit between 150 and 300)
+    or (ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('[STATE3.1]', '[STATE3.2]', '[STATE3.3]')
+        and ss_net_profit between 50 and 250))

@@ -1,0 +1,50 @@
+--@ LP1 = uniform(0, 190)
+--@ LP2 = uniform(0, 190)
+--@ LP3 = uniform(0, 190)
+--@ LP4 = uniform(0, 190)
+--@ LP5 = uniform(0, 190)
+--@ LP6 = uniform(0, 190)
+select *
+from (select avg(ss_list_price) B1_LP, count(ss_list_price) B1_CNT,
+             count(distinct ss_list_price) B1_CNTD
+      from store_sales
+      where ss_quantity between 0 and 5
+        and (ss_list_price between [LP1] and [LP1] + 10
+             or ss_coupon_amt between 459 and 459 + 1000
+             or ss_wholesale_cost between 57 and 57 + 20)) B1,
+     (select avg(ss_list_price) B2_LP, count(ss_list_price) B2_CNT,
+             count(distinct ss_list_price) B2_CNTD
+      from store_sales
+      where ss_quantity between 6 and 10
+        and (ss_list_price between [LP2] and [LP2] + 10
+             or ss_coupon_amt between 2323 and 2323 + 1000
+             or ss_wholesale_cost between 31 and 31 + 20)) B2,
+     (select avg(ss_list_price) B3_LP, count(ss_list_price) B3_CNT,
+             count(distinct ss_list_price) B3_CNTD
+      from store_sales
+      where ss_quantity between 11 and 15
+        and (ss_list_price between [LP3] and [LP3] + 10
+             or ss_coupon_amt between 1495 and 1495 + 1000
+             or ss_wholesale_cost between 52 and 52 + 20)) B3,
+     (select avg(ss_list_price) B4_LP, count(ss_list_price) B4_CNT,
+             count(distinct ss_list_price) B4_CNTD
+      from store_sales
+      where ss_quantity between 16 and 20
+        and (ss_list_price between [LP4] and [LP4] + 10
+             or ss_coupon_amt between 3854 and 3854 + 1000
+             or ss_wholesale_cost between 26 and 26 + 20)) B4,
+     (select avg(ss_list_price) B5_LP, count(ss_list_price) B5_CNT,
+             count(distinct ss_list_price) B5_CNTD
+      from store_sales
+      where ss_quantity between 21 and 25
+        and (ss_list_price between [LP5] and [LP5] + 10
+             or ss_coupon_amt between 7826 and 7826 + 1000
+             or ss_wholesale_cost between 38 and 38 + 20)) B5,
+     (select avg(ss_list_price) B6_LP, count(ss_list_price) B6_CNT,
+             count(distinct ss_list_price) B6_CNTD
+      from store_sales
+      where ss_quantity between 26 and 30
+        and (ss_list_price between [LP6] and [LP6] + 10
+             or ss_coupon_amt between 5270 and 5270 + 1000
+             or ss_wholesale_cost between 42 and 42 + 20)) B6
+limit 100

@@ -1,0 +1,13 @@
+--@ MONTH = uniform(11, 12)
+--@ MANUFACT = uniform(1, 1000)
+--@ AGGC = pick('ss_ext_sales_price', 'ss_sales_price', 'ss_ext_discount_amt', 'ss_net_profit')
+select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+       sum([AGGC]) sum_agg
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manufact_id = [MANUFACT]
+  and dt.d_moy = [MONTH]
+group by dt.d_year, item.i_brand_id, item.i_brand
+order by dt.d_year, sum_agg desc, brand_id
+limit 100

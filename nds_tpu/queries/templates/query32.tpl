@@ -1,0 +1,15 @@
+--@ SDATE = date(1998-01-01, 2002-10-01)
+--@ MANUFACT = uniform(1, 1000)
+select sum(cs_ext_discount_amt) as `excess discount amount`
+from catalog_sales, item, date_dim
+where i_manufact_id = [MANUFACT]
+  and i_item_sk = cs_item_sk
+  and d_date between cast('[SDATE]' as date) and (cast('[SDATE]' as date) + interval 90 days)
+  and d_date_sk = cs_sold_date_sk
+  and cs_ext_discount_amt > (select 1.3 * avg(cs_ext_discount_amt)
+                             from catalog_sales, date_dim
+                             where cs_item_sk = i_item_sk
+                               and d_date between cast('[SDATE]' as date)
+                                              and (cast('[SDATE]' as date) + interval 90 days)
+                               and d_date_sk = cs_sold_date_sk)
+limit 100

@@ -1,0 +1,31 @@
+--@ YEAR = uniform(1999, 2002)
+--@ AGG = pick('min', 'max', 'avg', 'sum')
+select ca_state, cd_gender, cd_marital_status, cd_dep_count,
+       count(*) cnt1,
+       [AGG](cd_dep_count) agg1,
+       cd_dep_employed_count,
+       count(*) cnt2,
+       [AGG](cd_dep_employed_count) agg2,
+       cd_dep_college_count,
+       count(*) cnt3,
+       [AGG](cd_dep_college_count) agg3
+from customer c, customer_address ca, customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from store_sales, date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk
+                and d_year = [YEAR] and d_qoy < 4)
+  and (exists (select * from web_sales, date_dim
+               where c.c_customer_sk = ws_bill_customer_sk
+                 and ws_sold_date_sk = d_date_sk
+                 and d_year = [YEAR] and d_qoy < 4)
+       or exists (select * from catalog_sales, date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk
+                    and d_year = [YEAR] and d_qoy < 4))
+group by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+order by ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+limit 100

@@ -1,0 +1,34 @@
+--@ YEAR = uniform(1998, 2002)
+--@ MS = pool(marital)
+--@ ES = pool(education)
+--@ STATE1 = sample(3, state)
+--@ STATE2 = sample(3, state)
+--@ STATE3 = sample(3, state)
+select sum(ss_quantity)
+from store_sales, store, customer_demographics, customer_address, date_dim
+where s_store_sk = ss_store_sk
+  and ss_sold_date_sk = d_date_sk and d_year = [YEAR]
+  and ((cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = '[MS]'
+        and cd_education_status = '[ES]'
+        and ss_sales_price between 100.00 and 150.00)
+    or (cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = '[MS]'
+        and cd_education_status = '[ES]'
+        and ss_sales_price between 50.00 and 100.00)
+    or (cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = '[MS]'
+        and cd_education_status = '[ES]'
+        and ss_sales_price between 150.00 and 200.00))
+  and ((ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('[STATE1.1]', '[STATE1.2]', '[STATE1.3]')
+        and ss_net_profit between 0 and 2000)
+    or (ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('[STATE2.1]', '[STATE2.2]', '[STATE2.3]')
+        and ss_net_profit between 150 and 3000)
+    or (ss_addr_sk = ca_address_sk
+        and ca_country = 'United States'
+        and ca_state in ('[STATE3.1]', '[STATE3.2]', '[STATE3.3]')
+        and ss_net_profit between 50 and 25000))

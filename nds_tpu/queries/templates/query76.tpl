@@ -1,0 +1,28 @@
+--@ NULLSS = pick('ss_store_sk', 'ss_addr_sk', 'ss_hdemo_sk', 'ss_cdemo_sk', 'ss_customer_sk', 'ss_promo_sk')
+--@ NULLWS = pick('ws_web_page_sk', 'ws_bill_addr_sk', 'ws_ship_hdemo_sk', 'ws_bill_customer_sk', 'ws_promo_sk')
+--@ NULLCS = pick('cs_warehouse_sk', 'cs_bill_addr_sk', 'cs_ship_hdemo_sk', 'cs_bill_customer_sk', 'cs_promo_sk')
+select channel, col_name, d_year, d_qoy, i_category, count(*) sales_cnt,
+       sum(ext_sales_price) sales_amt
+from (select 'store' as channel, '[NULLSS]' col_name, d_year, d_qoy,
+             i_category, ss_ext_sales_price ext_sales_price
+      from store_sales, item, date_dim
+      where [NULLSS] is null
+        and ss_sold_date_sk = d_date_sk
+        and ss_item_sk = i_item_sk
+      union all
+      select 'web' as channel, '[NULLWS]' col_name, d_year, d_qoy,
+             i_category, ws_ext_sales_price ext_sales_price
+      from web_sales, item, date_dim
+      where [NULLWS] is null
+        and ws_sold_date_sk = d_date_sk
+        and ws_item_sk = i_item_sk
+      union all
+      select 'catalog' as channel, '[NULLCS]' col_name, d_year, d_qoy,
+             i_category, cs_ext_sales_price ext_sales_price
+      from catalog_sales, item, date_dim
+      where [NULLCS] is null
+        and cs_sold_date_sk = d_date_sk
+        and cs_item_sk = i_item_sk) foo
+group by channel, col_name, d_year, d_qoy, i_category
+order by channel, col_name, d_year, d_qoy, i_category
+limit 100

@@ -1,0 +1,15 @@
+--@ SDATE = date(1998-01-01, 2002-10-01)
+--@ MANUF = sample(4, 1, 1000)
+--@ PRICE = uniform(0, 90)
+select i_item_id, i_item_desc, i_current_price
+from item, inventory, date_dim, store_sales
+where i_current_price between [PRICE] and [PRICE] + 30
+  and inv_item_sk = i_item_sk
+  and d_date_sk = inv_date_sk
+  and d_date between cast('[SDATE]' as date) and (cast('[SDATE]' as date) + interval 60 days)
+  and i_manufact_id in ([MANUF.1], [MANUF.2], [MANUF.3], [MANUF.4])
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id
+limit 100

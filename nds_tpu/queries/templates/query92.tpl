@@ -1,0 +1,16 @@
+--@ SDATE = date(1998-01-01, 2002-10-01)
+--@ MANUFACT = uniform(1, 1000)
+select sum(ws_ext_discount_amt) as `Excess Discount Amount`
+from web_sales, item, date_dim
+where i_manufact_id = [MANUFACT]
+  and i_item_sk = ws_item_sk
+  and d_date between cast('[SDATE]' as date) and (cast('[SDATE]' as date) + interval 90 days)
+  and d_date_sk = ws_sold_date_sk
+  and ws_ext_discount_amt > (select 1.3 * avg(ws_ext_discount_amt)
+                             from web_sales, date_dim
+                             where ws_item_sk = i_item_sk
+                               and d_date between cast('[SDATE]' as date)
+                                              and (cast('[SDATE]' as date) + interval 90 days)
+                               and d_date_sk = ws_sold_date_sk)
+order by sum(ws_ext_discount_amt)
+limit 100
