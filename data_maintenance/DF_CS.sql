@@ -1,0 +1,14 @@
+-- Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+-- Delete function DF_CS: remove catalog sales (and their returns) sold inside
+-- the [DATE1, DATE2] window (TPC-DS spec 5.3.11; ref: nds/data_maintenance/DF_CS.sql).
+DELETE FROM catalog_returns
+WHERE cr_order_number IN
+  (SELECT DISTINCT cs_order_number
+   FROM catalog_sales, date_dim
+   WHERE cs_sold_date_sk = d_date_sk
+     AND d_date BETWEEN 'DATE1' AND 'DATE2');
+DELETE FROM catalog_sales
+WHERE cs_sold_date_sk >= (SELECT min(d_date_sk) FROM date_dim
+                          WHERE d_date BETWEEN 'DATE1' AND 'DATE2')
+  AND cs_sold_date_sk <= (SELECT max(d_date_sk) FROM date_dim
+                          WHERE d_date BETWEEN 'DATE1' AND 'DATE2');

@@ -1,0 +1,8 @@
+-- Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+-- Delete function DF_I: remove inventory records inside the [DATE1, DATE2]
+-- window (TPC-DS spec 5.3.11; ref: nds/data_maintenance/DF_I.sql).
+DELETE FROM inventory
+WHERE inv_date_sk >= (SELECT min(d_date_sk) FROM date_dim
+                      WHERE d_date BETWEEN 'DATE1' AND 'DATE2')
+  AND inv_date_sk <= (SELECT max(d_date_sk) FROM date_dim
+                      WHERE d_date BETWEEN 'DATE1' AND 'DATE2');

@@ -1,0 +1,14 @@
+-- Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+-- Delete function DF_SS: remove store sales (and their returns) sold inside
+-- the [DATE1, DATE2] window (TPC-DS spec 5.3.11; ref: nds/data_maintenance/DF_SS.sql).
+DELETE FROM store_returns
+WHERE sr_ticket_number IN
+  (SELECT DISTINCT ss_ticket_number
+   FROM store_sales, date_dim
+   WHERE ss_sold_date_sk = d_date_sk
+     AND d_date BETWEEN 'DATE1' AND 'DATE2');
+DELETE FROM store_sales
+WHERE ss_sold_date_sk >= (SELECT min(d_date_sk) FROM date_dim
+                          WHERE d_date BETWEEN 'DATE1' AND 'DATE2')
+  AND ss_sold_date_sk <= (SELECT max(d_date_sk) FROM date_dim
+                          WHERE d_date BETWEEN 'DATE1' AND 'DATE2');

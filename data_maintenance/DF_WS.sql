@@ -1,0 +1,14 @@
+-- Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+-- Delete function DF_WS: remove web sales (and their returns) sold inside
+-- the [DATE1, DATE2] window (TPC-DS spec 5.3.11; ref: nds/data_maintenance/DF_WS.sql).
+DELETE FROM web_returns
+WHERE wr_order_number IN
+  (SELECT DISTINCT ws_order_number
+   FROM web_sales, date_dim
+   WHERE ws_sold_date_sk = d_date_sk
+     AND d_date BETWEEN 'DATE1' AND 'DATE2');
+DELETE FROM web_sales
+WHERE ws_sold_date_sk >= (SELECT min(d_date_sk) FROM date_dim
+                          WHERE d_date BETWEEN 'DATE1' AND 'DATE2')
+  AND ws_sold_date_sk <= (SELECT max(d_date_sk) FROM date_dim
+                          WHERE d_date BETWEEN 'DATE1' AND 'DATE2');

@@ -1,0 +1,14 @@
+-- Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+-- Refresh function LF_I: build inventory rows from the s_inventory refresh
+-- feed (TPC-DS spec 5.3; ref: nds/data_maintenance/LF_I.sql).
+CREATE TEMP VIEW refresh_inv AS
+SELECT
+  d_date_sk            AS inv_date_sk,
+  i_item_sk            AS inv_item_sk,
+  w_warehouse_sk       AS inv_warehouse_sk,
+  invn_qty_on_hand     AS inv_quantity_on_hand
+FROM s_inventory
+LEFT OUTER JOIN warehouse ON (invn_warehouse_id = w_warehouse_id)
+LEFT OUTER JOIN item      ON (invn_item_id = i_item_id AND i_rec_end_date IS NULL)
+LEFT OUTER JOIN date_dim  ON (d_date = invn_date);
+INSERT INTO inventory (SELECT * FROM refresh_inv ORDER BY inv_date_sk);

@@ -1,0 +1,39 @@
+-- Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+-- Refresh function LF_SR: build store_returns rows from the s_store_returns
+-- refresh feed (TPC-DS spec 5.3; ref: nds/data_maintenance/LF_SR.sql).
+CREATE TEMP VIEW refresh_sr AS
+SELECT
+  d_date_sk                                                        AS sr_returned_date_sk,
+  t_time_sk                                                        AS sr_return_time_sk,
+  i_item_sk                                                        AS sr_item_sk,
+  c_customer_sk                                                    AS sr_customer_sk,
+  c_current_cdemo_sk                                               AS sr_cdemo_sk,
+  c_current_hdemo_sk                                               AS sr_hdemo_sk,
+  c_current_addr_sk                                                AS sr_addr_sk,
+  s_store_sk                                                       AS sr_store_sk,
+  r_reason_sk                                                      AS sr_reason_sk,
+  sret_ticket_number                                               AS sr_ticket_number,
+  sret_return_qty                                                  AS sr_return_quantity,
+  sret_return_amt                                                  AS sr_return_amt,
+  sret_return_tax                                                  AS sr_return_tax,
+  sret_return_amt + sret_return_tax                                AS sr_return_amt_inc_tax,
+  sret_return_fee                                                  AS sr_fee,
+  sret_return_ship_cost                                            AS sr_return_ship_cost,
+  sret_refunded_cash                                               AS sr_refunded_cash,
+  sret_reversed_charge                                             AS sr_reversed_charge,
+  sret_store_credit                                                AS sr_store_credit,
+  sret_return_amt + sret_return_tax + sret_return_fee
+      - sret_refunded_cash - sret_reversed_charge
+      - sret_store_credit                                          AS sr_net_loss
+FROM s_store_returns
+LEFT OUTER JOIN date_dim ON (cast(sret_return_date AS date) = d_date)
+LEFT OUTER JOIN time_dim ON ((cast(substr(sret_return_time, 1, 2) AS integer) * 3600
+                              + cast(substr(sret_return_time, 4, 2) AS integer) * 60
+                              + cast(substr(sret_return_time, 7, 2) AS integer)) = t_time)
+LEFT OUTER JOIN item     ON (sret_item_id = i_item_id)
+LEFT OUTER JOIN customer ON (sret_customer_id = c_customer_id)
+LEFT OUTER JOIN store    ON (sret_store_id = s_store_id)
+LEFT OUTER JOIN reason   ON (sret_reason_id = r_reason_id)
+WHERE i_rec_end_date IS NULL
+  AND s_rec_end_date IS NULL;
+INSERT INTO store_returns (SELECT * FROM refresh_sr ORDER BY sr_returned_date_sk);

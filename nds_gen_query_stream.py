@@ -44,11 +44,12 @@ if __name__ == "__main__":
                         help="seed the random generation of the queries.")
     args = parser.parse_args()
 
+    template_dir = None
     if args.template_dir != TEMPLATE_DIR:
-        import nds_tpu.queries as q
-        q.TEMPLATE_DIR = get_abs_path(args.template_dir)
+        template_dir = get_abs_path(args.template_dir)
     generate_query_streams(
         get_abs_path(args.output_dir),
         streams=int(args.streams) if args.streams else None,
         template=args.template,
-        rngseed=int(args.rngseed) if args.rngseed else None)
+        rngseed=int(args.rngseed) if args.rngseed else None,
+        template_dir=template_dir)

@@ -29,10 +29,11 @@ if __name__ == "__main__":
                         help="query stream file that contains NDS queries in "
                         "specific order.")
     parser.add_argument("time_log",
+                        nargs="?",
                         help="path to execution time log.",
                         default="")
     parser.add_argument("--input_format",
-                        choices=["parquet", "orc", "avro", "csv", "json",
+                        choices=["parquet", "orc", "csv", "json",
                                  "iceberg", "delta"],
                         default="parquet",
                         help="type for input data source.")

@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Rollback utility: undo Data Maintenance via warehouse time travel.
+
+TPU-build equivalent of the reference Iceberg rollback CLI (ref:
+nds/nds_rollback.py:37-59): restores the 6 DM-affected fact tables to their
+last snapshot at-or-before a timestamp (the
+``system.rollback_to_timestamp`` role).
+"""
+
+import argparse
+import os
+import sys
+from datetime import datetime
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# the 6 fact tables touched by Data Maintenance (ref: nds/nds_rollback.py:37)
+tables_to_rollback = [
+    'catalog_sales',
+    'catalog_returns',
+    'inventory',
+    'store_returns',
+    'store_sales',
+    'web_returns',
+    'web_sales']
+
+
+def rollback(warehouse_path: str, timestamp: str) -> None:
+    from nds_tpu.warehouse import Warehouse
+    ts_ms = int(datetime.strptime(timestamp,
+                                  "%Y-%m-%d %H:%M:%S").timestamp() * 1000)
+    warehouse = Warehouse(warehouse_path)
+    for table in tables_to_rollback:
+        if not warehouse.exists(table):
+            print(f"skip {table}: not in warehouse")
+            continue
+        snap_id = warehouse.rollback_to_timestamp(table, ts_ms)
+        print(f"rolled back {table} to snapshot {snap_id}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument('warehouse_path',
+                        help='warehouse root the Data Maintenance test ran '
+                        'against.')
+    parser.add_argument('timestamp',
+                        help="timestamp to rollback to, e.g. '2026-07-29 "
+                        "09:50:00'. Usually the time before a Data "
+                        "Maintenance test.")
+    args = parser.parse_args()
+    rollback(args.warehouse_path, args.timestamp)

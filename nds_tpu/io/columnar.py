@@ -96,4 +96,9 @@ def read_table(path: str, fmt: str = "parquet") -> pa.Table:
         files = [os.path.join(path, f) for f in sorted(os.listdir(path))
                  if f.endswith(".csv")]
         return pa.concat_tables([pacsv.read_csv(f) for f in files])
+    if fmt == "json":
+        import pyarrow.json as pajson
+        files = [os.path.join(path, f) for f in sorted(os.listdir(path))
+                 if f.endswith(".json")]
+        return pa.concat_tables([pajson.read_json(f) for f in files])
     raise ValueError(f"unsupported input format: {fmt}")

@@ -151,8 +151,7 @@ def run_query_stream(input_prefix: str,
         from nds_tpu.warehouse import Warehouse
         wh = Warehouse(input_prefix)
         session.warehouse = wh
-        for table_name in wh.table_names():
-            from nds_tpu.engine.column import from_arrow
+        for table_name in wh.tables():
             start = time.time()
             session.create_temp_view(table_name, wh.read(table_name))
             execution_time_list.append(

@@ -195,23 +195,24 @@ def instantiate_template(text: str, rng: np.random.Generator) -> str:
     return out.strip("\n")
 
 
-def list_templates() -> list:
+def list_templates(template_dir: str | None = None) -> list:
     """templates.lst order (ref: the toolkit's templates.lst consumed at
     nds/nds_gen_query_stream.py:64)."""
-    lst = os.path.join(TEMPLATE_DIR, "templates.lst")
+    lst = os.path.join(template_dir or TEMPLATE_DIR, "templates.lst")
     with open(lst) as f:
         return [ln.strip() for ln in f if ln.strip()]
 
 
-def load_template(name: str) -> str:
-    with open(os.path.join(TEMPLATE_DIR, name)) as f:
+def load_template(name: str, template_dir: str | None = None) -> str:
+    with open(os.path.join(template_dir or TEMPLATE_DIR, name)) as f:
         return f.read()
 
 
-def _stream_text(order, stream_id: int, rng: np.random.Generator) -> str:
+def _stream_text(order, stream_id: int, rng: np.random.Generator,
+                 template_dir: str | None = None) -> str:
     parts = []
     for pos, tpl_name in enumerate(order):
-        sql = instantiate_template(load_template(tpl_name), rng)
+        sql = instantiate_template(load_template(tpl_name, template_dir), rng)
         head = (f"-- start query {pos + 1} in stream {stream_id} "
                 f"using template {tpl_name}")
         tail = (f"-- end query {pos + 1} in stream {stream_id} "
@@ -225,7 +226,8 @@ def _stream_text(order, stream_id: int, rng: np.random.Generator) -> str:
 def generate_query_streams(output_dir: str, streams: int | None = None,
                            template: str | None = None,
                            rngseed: int | None = None,
-                           templates: list | None = None) -> list:
+                           templates: list | None = None,
+                           template_dir: str | None = None) -> list:
     """Write ``query_<i>.sql`` stream files (or a single named query file).
 
     Mirrors dsqgen semantics: ``streams`` permuted full streams, or one
@@ -234,12 +236,13 @@ def generate_query_streams(output_dir: str, streams: int | None = None,
     """
     os.makedirs(output_dir, exist_ok=True)
     seed = 19620718 if rngseed is None else int(rngseed)
-    all_templates = templates if templates is not None else list_templates()
+    all_templates = templates if templates is not None else \
+        list_templates(template_dir)
     written = []
 
     if template is not None:
         rng = np.random.default_rng(seed)
-        text = _stream_text([template], 0, rng)
+        text = _stream_text([template], 0, rng, template_dir)
         qname = template[:-4]  # strip .tpl
         if any(str(q) in template for q in SPECIAL_SPLIT):
             part1, part2 = split_special_query(text)
@@ -263,7 +266,7 @@ def generate_query_streams(output_dir: str, streams: int | None = None,
             order = [order[i] for i in rng.permutation(len(order))]
         path = os.path.join(output_dir, f"query_{s}.sql")
         with open(path, "w") as f:
-            f.write(_stream_text(order, s, rng))
+            f.write(_stream_text(order, s, rng, template_dir))
         written.append(path)
     return written
 

@@ -65,7 +65,7 @@ class Planner:
                 scope[name.lower()] = self.query(cq)
             out = self.set_expr(q.body)
             if q.order_by:
-                out = self._apply_order_by(out, q.order_by)
+                out = self._apply_order_by(out, q.order_by, q.body)
             if q.limit is not None:
                 out = DeviceTable(
                     dict(E.limit_table(out, q.limit).columns), min(q.limit, out.nrows))
@@ -73,16 +73,30 @@ class Planner:
         finally:
             self.cte_stack.pop()
 
-    def _apply_order_by(self, out: DeviceTable, order_by) -> DeviceTable:
+    def _apply_order_by(self, out: DeviceTable, order_by,
+                        body=None) -> DeviceTable:
         names = out.column_names
         keys, desc, nl = [], [], []
         ctx = EvalCtx(out)
         # output aliases are directly addressable in ORDER BY
         for n in names:
             ctx.select_aliases[n.lower()] = out[n]
+        # ORDER BY may repeat a select-item expression verbatim (e.g.
+        # ``order by count(distinct x)``); resolve those positionally instead
+        # of re-evaluating an aggregate over the output
+        item_keys = {}
+        if body is not None and isinstance(body, A.Select) and \
+                not any(isinstance(it.expr, A.Star) for it in body.items):
+            # (a Star item expands to several output columns, breaking the
+            # positional item -> output-name correspondence)
+            for i, it in enumerate(body.items):
+                if i < len(names):
+                    item_keys.setdefault(expr_key(it.expr), names[i])
         for e, d, last in order_by:
             if isinstance(e, A.Literal) and isinstance(e.value, int):
                 col = out[names[e.value - 1]]
+            elif expr_key(e) in item_keys:
+                col = out[item_keys[expr_key(e)]]
             else:
                 col = self.eval_expr(e, ctx)
             keys.append(col)
@@ -193,6 +207,40 @@ class Planner:
             return self._split_conjuncts(e.left) + self._split_conjuncts(e.right)
         return [e] if e is not None else []
 
+    def _split_disjuncts(self, e):
+        if isinstance(e, A.BinaryOp) and e.op == "or":
+            return self._split_disjuncts(e.left) + self._split_disjuncts(e.right)
+        return [e]
+
+    @staticmethod
+    def _fold_bool(op: str, exprs):
+        out = exprs[0]
+        for e in exprs[1:]:
+            out = A.BinaryOp(op, out, e)
+        return out
+
+    def _hoist_or_conjuncts(self, e):
+        """Factor conjuncts common to every disjunct out of an OR:
+        ``(A and X) or (A and Y)`` → ``[A, (X or Y)]``. The TPC-DS corpus
+        (q13/q48/q85) hides its equi-join keys this way; without hoisting the
+        join planner would fall back to a cartesian against the 1.9M-row
+        customer_demographics dimension."""
+        if not (isinstance(e, A.BinaryOp) and e.op == "or"):
+            return [e]
+        conj_lists = [self._split_conjuncts(d) for d in self._split_disjuncts(e)]
+        common = [c for c in conj_lists[0]
+                  if all(any(c == d for d in dl) for dl in conj_lists[1:])]
+        if not common:
+            return [e]
+        rests = []
+        for dl in conj_lists:
+            rest = [c for c in dl if not any(c == cm for cm in common)]
+            if not rest:
+                # one disjunct is exactly the common set: OR degenerates
+                return common
+            rests.append(self._fold_bool("and", rest))
+        return common + [self._fold_bool("or", rests)]
+
     def _expr_tables(self, e, available: set) -> set:
         """Set of alias-qualified table names an expression references."""
         out = set()
@@ -232,20 +280,30 @@ class Planner:
 
     def _binary_join(self, left: DeviceTable, right: DeviceTable, kind: str,
                      condition) -> DeviceTable:
-        conjuncts = self._split_conjuncts(condition)
+        conjuncts = [h for c in self._split_conjuncts(condition)
+                     for h in self._hoist_or_conjuncts(c)]
         lcols, rcols = set(left.column_names), set(right.column_names)
-        equi, residual = [], []
+        equi, lkeys, rkeys, residual = [], [], [], []
+        all_plain = True
         for c in conjuncts:
             pair = self._equi_pair(c, lcols, rcols)
             if pair:
                 equi.append(pair)
-            else:
-                residual.append(c)
+                lkeys.append(left[pair[0]])
+                rkeys.append(right[pair[1]])
+                continue
+            keypair = self._equi_key_cols(c, left, right)
+            if keypair:
+                # expression equi-key (e.g. cast(col as date) = d_date):
+                # evaluate each side against its input as a synthetic key
+                all_plain = False
+                lkeys.append(keypair[0])
+                rkeys.append(keypair[1])
+                continue
+            residual.append(c)
         if kind in ("semi", "anti"):
-            if not equi:
+            if not lkeys:
                 raise ExecError("semi/anti join requires equi condition")
-            lkeys = [left[l] for l, _ in equi]
-            rkeys = [right[r] for _, r in equi]
             if residual:
                 # a left row matches only if some equi-matching right row also
                 # satisfies the residual conjuncts
@@ -261,7 +319,7 @@ class Planner:
                 matched = E.semi_join_mask(lkeys, rkeys)
             mask = ~matched if kind == "anti" else matched
             return left.take(jnp.nonzero(mask)[0])
-        if not equi:
+        if not lkeys:
             # pure cartesian with optional residual filter
             out = self._cartesian(left, right)
             if residual:
@@ -269,13 +327,13 @@ class Planner:
             if kind != "inner":
                 raise ExecError("non-equi outer joins unsupported")
             return out
-        l_on = [l for l, _ in equi]
-        r_on = [r for _, r in equi]
-        if not residual:
+        if not residual and all_plain:
+            l_on = [l for l, _ in equi]
+            r_on = [r for _, r in equi]
             return E.join_tables(left, right, l_on, r_on, kind)
-        # join with residual: filter the matched pairs, then rebuild outer rows
-        l_idx, r_idx, _, _ = E.join_indices(
-            [left[c] for c in l_on], [right[c] for c in r_on], "inner")
+        # join with residual and/or expression keys: match pairs on the key
+        # columns, filter by the residual conjuncts, then rebuild outer rows
+        l_idx, r_idx, _, _ = E.join_indices(lkeys, rkeys, "inner")
         pair_cols = {n: c.take(l_idx) for n, c in left.columns.items()}
         pair_cols.update({n: c.take(r_idx) for n, c in right.columns.items()})
         pairs = DeviceTable(pair_cols, int(l_idx.shape[0]))
@@ -315,6 +373,46 @@ class Planner:
             rk2 = self._resolve_name(c.left, rcols)
             if lk2 and rk2:
                 return (lk2, rk2)
+        return None
+
+    def _column_refs(self, e):
+        out = []
+
+        def walk(node):
+            if isinstance(node, A.ColumnRef):
+                out.append(node)
+            if hasattr(node, "__dataclass_fields__"):
+                for f in vars(node).values():
+                    if isinstance(f, A.Expr):
+                        walk(f)
+                    elif isinstance(f, list):
+                        for x in f:
+                            if isinstance(x, A.Expr):
+                                walk(x)
+                            elif isinstance(x, tuple):
+                                for y in x:
+                                    if isinstance(y, A.Expr):
+                                        walk(y)
+        walk(e)
+        return out
+
+    def _equi_key_cols(self, c, left: DeviceTable, right: DeviceTable):
+        """(left key Column, right key Column) for an ``expr = expr`` conjunct
+        whose sides each reference exactly one join input (e.g.
+        ``cast(purc_purchase_date as date) = d_date``); None otherwise."""
+        if not (isinstance(c, A.BinaryOp) and c.op == "="):
+            return None
+        lcols, rcols = set(left.column_names), set(right.column_names)
+        for a, b, ltab, rtab in ((c.left, c.right, left, right),
+                                 (c.right, c.left, left, right)):
+            arefs = self._column_refs(a)
+            brefs = self._column_refs(b)
+            if not arefs or not brefs:
+                continue
+            if all(self._resolve_name(r, lcols) for r in arefs) and \
+                    all(self._resolve_name(r, rcols) for r in brefs):
+                return (self.eval_expr(a, EvalCtx(ltab)),
+                        self.eval_expr(b, EvalCtx(rtab)))
         return None
 
     def _cartesian(self, left: DeviceTable, right: DeviceTable) -> DeviceTable:
@@ -423,7 +521,8 @@ class Planner:
 
     def select(self, sel: A.Select) -> DeviceTable:
         parts, join_preds = ([], []) if sel.from_ is None else self._flatten_from(sel.from_)
-        where_conjuncts = self._split_conjuncts(sel.where)
+        where_conjuncts = [h for c in self._split_conjuncts(sel.where)
+                           for h in self._hoist_or_conjuncts(c)]
         if sel.from_ is None:
             table = DeviceTable({}, 1)
             table = self._filter_conjuncts(table, where_conjuncts)
@@ -1050,7 +1149,7 @@ class Planner:
         inner_cols = self._select_output_cols(sel.from_)
         outer_cols = set(ctx.table.column_names)
         conjs = self._split_conjuncts(sel.where)
-        corr, keep = [], []
+        corr, keep, residual = [], [], []
         for c in conjs:
             pair = None
             if isinstance(c, A.BinaryOp) and c.op == "=" and \
@@ -1065,8 +1164,13 @@ class Planner:
                     pair = (c.right, c.left)
             if pair:
                 corr.append(pair)
-            else:
+            elif all(self._resolve_name(r, inner_cols)
+                     for r in self._column_refs(c)):
                 keep.append(c)
+            else:
+                # references both scopes without being an equality (e.g.
+                # q16's cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+                residual.append(c)
         if not corr:
             return None
         new_where = None
@@ -1076,7 +1180,7 @@ class Planner:
             A.Select(sel.items, sel.from_, new_where, sel.group_by, sel.having,
                      sel.distinct),
             [], None, [])
-        return corr, stripped
+        return corr, stripped, residual
 
     def _eval_exists(self, e: A.Exists, ctx: EvalCtx) -> Column:
         n = ctx.table.nrows
@@ -1086,8 +1190,31 @@ class Planner:
             val = t.nrows > 0
             res = Column("bool", jnp.full(n, val, dtype=bool))
             return X.logical_not(res) if e.negated else res
-        corr, stripped = found
+        corr, stripped, residual = found
         sel = stripped.body
+        if residual:
+            # non-equality correlated conjuncts (q16/q94: cs1.x <> cs2.x):
+            # match pairs on the equality keys, then evaluate the residual on
+            # the joined pair table
+            if sel.group_by or sel.having:
+                raise ExecError("correlated EXISTS with residual predicate "
+                                "and grouping unsupported")
+            parts, preds = self._flatten_from(sel.from_)
+            inner_t = self._join_parts(parts, preds,
+                                       self._split_conjuncts(sel.where))
+            lkeys = [self.eval_expr(outer, ctx) for outer, _ in corr]
+            rkeys = [self.eval_expr(inner, EvalCtx(inner_t))
+                     for _, inner in corr]
+            l_idx, r_idx, _, _ = E.join_indices(lkeys, rkeys, "inner")
+            pair_cols = {nm: c.take(r_idx)
+                         for nm, c in inner_t.columns.items()}
+            for nm, c in ctx.table.columns.items():
+                pair_cols.setdefault(nm, c.take(l_idx))
+            pairs = DeviceTable(pair_cols, int(l_idx.shape[0]))
+            ok = self._conjunct_mask(pairs, residual)
+            hit = jnp.take(l_idx, jnp.nonzero(ok)[0])
+            matched = jnp.zeros(n, dtype=bool).at[hit].set(True)
+            return Column("bool", ~matched if e.negated else matched)
         inner_items = [A.SelectItem(inner, f"_ck{i}")
                        for i, (_, inner) in enumerate(corr)]
         sub = A.Query(A.Select(inner_items, sel.from_, sel.where, sel.group_by,
@@ -1113,7 +1240,9 @@ class Planner:
                     return Column("bool", jnp.zeros(len(lcol2), dtype=bool))
                 return Column("bool", mask & lcol2.valid_mask())
             return Column("bool", mask)
-        corr, stripped = found
+        corr, stripped, residual = found
+        if residual:
+            raise ExecError("correlated subquery with non-equality correlation unsupported here")
         sel = stripped.body
         items = [sel.items[0]] + [A.SelectItem(inner, f"_ck{i}")
                                   for i, (_, inner) in enumerate(corr)]
@@ -1156,7 +1285,9 @@ class Planner:
             if col.valid is not None:
                 valid = jnp.broadcast_to(col.valid[0], (n,))
             return Column(col.kind, data, valid, col.dict_values)
-        corr, stripped = found
+        corr, stripped, residual = found
+        if residual:
+            raise ExecError("correlated subquery with non-equality correlation unsupported here")
         sel = stripped.body
         # grouped-by-correlation-keys aggregate, left-joined back to the outer
         items = [sel.items[0]] + [A.SelectItem(inner, f"_ck{i}")

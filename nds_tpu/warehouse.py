@@ -1,0 +1,150 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Snapshot warehouse: the mutable-table layer Data Maintenance needs.
+
+Plays the role Iceberg/Delta play for the reference (snapshot isolation for
+INSERT/DELETE refresh functions, and time-travel rollback; ref:
+nds/nds_maintenance.py:191-268 writes into an Iceberg/Delta warehouse and
+nds/nds_rollback.py:46-50 calls ``rollback_to_timestamp``). Layout per table:
+
+    <root>/<table>/snap-<id>.parquet       immutable full-table snapshots
+    <root>/<table>/metadata.json           snapshot log (id, timestamp_ms, file)
+
+Each mutation (create / insert / delete-rewrite) lands a new full snapshot and
+appends to the log; ``read`` serves the latest, ``rollback_to_timestamp``
+truncates the log back to the last snapshot at-or-before the timestamp. Full
+(not delta) snapshots keep the commit path one parquet write — the NDS
+refresh sets are ~0.1% of the base facts, and the spec times the refresh
+function, not compaction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+
+import pyarrow as pa
+
+from nds_tpu.io.columnar import read_table, write_table
+
+
+class WarehouseError(RuntimeError):
+    pass
+
+
+class Warehouse:
+    def __init__(self, root: str, fmt: str = "parquet"):
+        self.root = root
+        self.fmt = fmt
+        os.makedirs(root, exist_ok=True)
+
+    # -- metadata -----------------------------------------------------------
+
+    def _meta_path(self, table: str) -> str:
+        return os.path.join(self.root, table, "metadata.json")
+
+    def _load_meta(self, table: str) -> dict:
+        path = self._meta_path(table)
+        if not os.path.exists(path):
+            raise WarehouseError(f"table '{table}' does not exist in {self.root}")
+        with open(path) as f:
+            return json.load(f)
+
+    def _store_meta(self, table: str, meta: dict) -> None:
+        tmp = self._meta_path(table) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, self._meta_path(table))
+
+    def _commit(self, table: str, arrow: pa.Table, meta: dict) -> None:
+        snap_id = (meta["snapshots"][-1]["id"] + 1) if meta["snapshots"] else 0
+        fname = f"snap-{snap_id}.{self.fmt}"
+        write_table(arrow, os.path.join(self.root, table, fname), self.fmt)
+        meta["snapshots"].append({
+            "id": snap_id,
+            "timestamp_ms": int(time.time() * 1000),
+            "file": fname,
+        })
+        self._store_meta(table, meta)
+
+    # -- public surface ------------------------------------------------------
+
+    def tables(self) -> list:
+        return sorted(
+            d for d in os.listdir(self.root)
+            if os.path.exists(self._meta_path(d)))
+
+    def exists(self, table: str) -> bool:
+        return os.path.exists(self._meta_path(table))
+
+    def create(self, table: str, arrow: pa.Table) -> None:
+        os.makedirs(os.path.join(self.root, table), exist_ok=True)
+        meta = {"snapshots": []}
+        self._commit(table, arrow, meta)
+
+    def read(self, table: str, snapshot_id: int | None = None) -> pa.Table:
+        meta = self._load_meta(table)
+        snaps = meta["snapshots"]
+        if not snaps:
+            raise WarehouseError(f"table '{table}' has no snapshots")
+        snap = snaps[-1]
+        if snapshot_id is not None:
+            matches = [s for s in snaps if s["id"] == snapshot_id]
+            if not matches:
+                raise WarehouseError(
+                    f"table '{table}' has no snapshot id {snapshot_id}")
+            snap = matches[0]
+        return read_table(os.path.join(self.root, table, snap["file"]), self.fmt)
+
+    @staticmethod
+    def _cast_like(arrow: pa.Table, schema: pa.Schema) -> pa.Table:
+        """Align column order and types with the table schema. Decimal
+        expressions widen scale during arithmetic (e.g. price * tax_rate), so
+        rescaling back to the declared decimal(p,s) must round, not raise."""
+        import pyarrow.compute as pc
+        cols = []
+        for field in schema:
+            col = arrow.column(field.name)
+            if col.type != field.type:
+                if pa.types.is_decimal(field.type) and \
+                        pa.types.is_decimal(col.type):
+                    col = pc.round(col, ndigits=field.type.scale)
+                col = pc.cast(col, field.type, safe=False)
+            cols.append(col)
+        return pa.table(cols, schema=schema)
+
+    def insert(self, table: str, arrow: pa.Table) -> None:
+        meta = self._load_meta(table)
+        current = self.read(table)
+        arrow = self._cast_like(arrow, current.schema)
+        self._commit(table, pa.concat_tables([current, arrow]), meta)
+
+    def overwrite(self, table: str, arrow: pa.Table) -> None:
+        meta = self._load_meta(table)
+        current_schema = self.read(table).schema
+        self._commit(table, self._cast_like(arrow, current_schema), meta)
+
+    def snapshots(self, table: str) -> list:
+        return list(self._load_meta(table)["snapshots"])
+
+    def rollback_to_timestamp(self, table: str, timestamp_ms: int) -> int:
+        """Truncate the snapshot log to the last snapshot at-or-before
+        ``timestamp_ms``; returns the restored snapshot id (the Iceberg
+        ``system.rollback_to_timestamp`` contract, ref: nds/nds_rollback.py:
+        46-50)."""
+        meta = self._load_meta(table)
+        keep = [s for s in meta["snapshots"] if s["timestamp_ms"] <= timestamp_ms]
+        if not keep:
+            raise WarehouseError(
+                f"table '{table}' has no snapshot at or before {timestamp_ms}")
+        dropped = meta["snapshots"][len(keep):]
+        meta["snapshots"] = keep
+        self._store_meta(table, meta)
+        for s in dropped:
+            path = os.path.join(self.root, table, s["file"])
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            elif os.path.exists(path):
+                os.remove(path)
+        return keep[-1]["id"]

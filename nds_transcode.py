@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Transcode driver — the NDS "Load Test".
+
+TPU-build equivalent of the reference transcode CLI (ref: nds/nds_transcode.py:
+154-315): reads the raw '|'-delimited generator output with the explicit
+schemas, writes each table as parquet/orc (date-partitioning the 7 fact
+tables, single file for the rest), or lands them in the snapshot warehouse
+(the Iceberg/Delta CTAS role), timing each table and emitting the Load Test
+report with the spec RNGSEED (end-of-load timestamp, TPC-DS v3.2.0 4.3.1).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from nds_tpu.check import check_version, get_abs_path  # noqa: E402
+
+check_version()
+
+
+def load(args, table_name, fields):
+    """Raw csv -> arrow with explicit schema (ref: nds/nds_transcode.py:56-66)."""
+    from nds_tpu.io import read_raw_table
+    path = get_abs_path(os.path.join(args.input_prefix, table_name))
+    if not os.path.exists(path):
+        alt = path + ".dat"
+        if os.path.exists(alt):
+            path = alt
+        else:
+            raise FileNotFoundError(f"no raw data for table {table_name} at {path}")
+    return read_raw_table(path, fields)
+
+
+def store(args, arrow, table_name, warehouse):
+    """Write one table to the output location (ref: nds/nds_transcode.py:69-152)."""
+    from nds_tpu.io import write_table
+    from nds_tpu.io.columnar import TABLE_PARTITIONING
+
+    if args.output_format in ("iceberg", "delta"):
+        # warehouse CTAS role: snapshot table in the warehouse root
+        warehouse.create(table_name, arrow)
+        return
+    out = os.path.join(args.output_prefix, table_name)
+    partition_col = None
+    if table_name in TABLE_PARTITIONING and not args.update:
+        partition_col = TABLE_PARTITIONING[table_name]
+    write_table(arrow, out, args.output_format,
+                partition_col=partition_col,
+                compression=args.compression)
+
+
+def transcode(args):
+    from nds_tpu.schema import get_schemas, get_maintenance_schemas
+    from nds_tpu.warehouse import Warehouse
+
+    start_ts = time.time()
+
+    if args.update:
+        schemas = get_maintenance_schemas(use_decimal=not args.floats)
+    else:
+        schemas = get_schemas(use_decimal=not args.floats)
+
+    if args.tables:
+        missing = [t for t in args.tables if t not in schemas]
+        if missing:
+            raise ValueError(f"unknown tables: {missing}; "
+                             f"known: {sorted(schemas)}")
+        schemas = {t: schemas[t] for t in args.tables}
+
+    warehouse = None
+    if args.output_format in ("iceberg", "delta"):
+        warehouse = Warehouse(args.output_prefix, fmt="parquet")
+
+    load_times = {}
+    for table, fields in schemas.items():
+        start = time.perf_counter()
+        try:
+            store(args, load(args, table, fields), table, warehouse)
+        except FileNotFoundError as e:
+            if args.allow_missing:
+                print(f"skip {table}: {e}")
+                continue
+            raise
+        load_times[table] = time.perf_counter() - start
+        print(f"transcoded {table} in {load_times[table]:.2f}s")
+
+    end = time.time()
+    # spec 4.3.1: RNGSEED for stream generation = load end timestamp,
+    # format mmddHHMMSSfff (ref: nds/nds_transcode.py:205-229)
+    rngseed = time.strftime("%m%d%H%M%S", time.localtime(end)) + \
+        f"{int((end % 1) * 1000):03d}"
+
+    report = []
+    report.append("NDS Load Test (transcode) report")
+    report.append(f"Load Test Time: {sum(load_times.values())}")
+    report.append(f"Load Test start time: {start_ts}")
+    report.append(f"Load Test end time: {end}")
+    report.append(f"RNGSEED used: {rngseed}")
+    report.append("")
+    report.append("=== Per-table times (seconds) ===")
+    for table, t in load_times.items():
+        report.append(f"{table}: {t}")
+    report.append("")
+    report.append("=== Configuration ===")
+    report.append(f"input_prefix: {args.input_prefix}")
+    report.append(f"output_prefix: {args.output_prefix}")
+    report.append(f"output_format: {args.output_format}")
+    report.append(f"compression: {args.compression}")
+    report.append(f"floats: {args.floats}")
+    text = "\n".join(report) + "\n"
+    if args.report_file:
+        with open(args.report_file, "w") as f:
+            f.write(text)
+    print(text)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("input_prefix",
+                        help="text to prepend to every input file path (the "
+                        "raw data root from nds_gen_data.py)")
+    parser.add_argument("output_prefix",
+                        help="text to prepend to every output file path; the "
+                        "warehouse root for iceberg/delta output formats")
+    parser.add_argument("report_file",
+                        help="location to store a performance report (local)")
+    parser.add_argument("--output_format",
+                        choices=["parquet", "orc", "csv", "iceberg", "delta"],
+                        default="parquet",
+                        help="output data format")
+    parser.add_argument("--tables", nargs="+",
+                        help="specify table names by space-separated. Allowed "
+                        "values are the 24 source / 12 refresh table names")
+    parser.add_argument("--output_mode",
+                        choices=["overwrite", "errorifexists"],
+                        default="overwrite",
+                        help="save mode when writing data")
+    parser.add_argument("--compression",
+                        help="codec for the output format (snappy/zstd/...)")
+    parser.add_argument("--update", action="store_true",
+                        help="transcode the refresh (Data Maintenance) tables")
+    parser.add_argument("--floats", action="store_true",
+                        help="use double instead of decimal for monetary columns")
+    parser.add_argument("--allow_missing", action="store_true",
+                        help="skip tables whose raw data is absent")
+    args = parser.parse_args()
+
+    if args.output_mode == "errorifexists" and os.path.exists(args.output_prefix) \
+            and os.listdir(args.output_prefix):
+        print(f"output {args.output_prefix} exists and is not empty", file=sys.stderr)
+        sys.exit(1)
+
+    transcode(args)

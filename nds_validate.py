@@ -1,0 +1,240 @@
+#!/usr/bin/env python3
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Validation driver: row-level parity check between two Power Run outputs.
+
+TPU-build equivalent of the reference validator (ref: nds/nds_validate.py:
+48-362): for each query in a stream, load both outputs, compare row counts,
+optionally sort (non-float columns first, float columns last), then compare
+row by row with relative-epsilon float/Decimal handling, NaN==NaN and
+None==None semantics, the query78 rounded-ratio tolerance, the permanent
+query65 skip and the float-mode query67 skip — and patch
+``queryValidationStatus`` (Pass / Fail / NotAttempted) into the per-query
+JSON summaries.
+"""
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+from decimal import Decimal
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from nds_tpu.check import check_version  # noqa: E402
+
+check_version()
+
+
+def _load_rows(path: str, fmt: str, ignore_ordering: bool):
+    """Load a query output directory into a list of row tuples, sorted by
+    non-float columns then float columns when ignore_ordering is set (the
+    collect_results contract, ref: nds/nds_validate.py:116-144)."""
+    from nds_tpu.io import read_table
+    table = read_table(path, fmt)
+    import pyarrow as pa
+    import pyarrow.compute as pc  # noqa: F401
+    if ignore_ordering and table.num_rows:
+        float_types = (pa.float32(), pa.float64())
+        non_float = [f.name for f in table.schema if f.type not in float_types]
+        floats = [f.name for f in table.schema if f.type in float_types]
+        keys = [(name, "ascending") for name in non_float + floats]
+        table = table.take(pa.compute.sort_indices(table, sort_keys=keys))
+    cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
+    rows = list(zip(*cols)) if cols else []
+    return rows
+
+
+def compare(expected, actual, epsilon=0.00001):
+    """Scalar comparison semantics (ref: nds/nds_validate.py:194-215)."""
+    if isinstance(expected, float) and isinstance(actual, float):
+        if math.isnan(expected) and math.isnan(actual):
+            return True
+        return math.isclose(expected, actual, rel_tol=epsilon)
+    if isinstance(expected, str) and isinstance(actual, str):
+        return expected == actual
+    if expected is None and actual is None:
+        return True
+    if (expected is None) != (actual is None):
+        return False
+    if isinstance(expected, Decimal) and isinstance(actual, Decimal):
+        return math.isclose(expected, actual, rel_tol=epsilon)
+    return expected == actual
+
+
+def rowEqual(row1, row2, epsilon, is_q78, q78_problematic_col):
+    """Row comparison incl. the q78 rounded-ratio column tolerance
+    (ref: nds/nds_validate.py:166-192)."""
+    if is_q78:
+        if q78_problematic_col not in (2, 4):
+            raise Exception("q78 problematic column should be 2nd or 4th, "
+                            f"but get {q78_problematic_col}")
+        row1 = list(row1)
+        row2 = list(row2)
+        v1 = row1.pop(q78_problematic_col - 1)
+        v2 = row2.pop(q78_problematic_col - 1)
+        if v1 is not None and v2 is not None:
+            # ratio is round(x, 2): allow diff <= 0.01 + epsilon
+            eq = abs(float(v1) - float(v2)) <= 0.01001
+        else:
+            eq = v1 is None and v2 is None
+        return eq and all(compare(a, b, epsilon) for a, b in zip(row1, row2))
+    return all(compare(a, b, epsilon) for a, b in zip(row1, row2))
+
+
+def check_nth_col_problematic_q78(q78_content: str) -> int:
+    """Find the 1-based index of the rounded-ratio column in the q78 text
+    (ref: nds/nds_validate.py:146-164)."""
+    last_between = q78_content.split("select")[-1].split("from")[0]
+    target_splits = re.split(", |,\n", last_between)
+    nth = -1
+    for index, string in enumerate(target_splits):
+        if "ratio" in string:
+            nth = index
+    if nth == -1:
+        raise Exception("Cannot find the problematic column in the query78 "
+                        f"content. Please check the content.\n{q78_content}")
+    return nth + 1
+
+
+def compare_results(input1, input2, input1_format, input2_format,
+                    ignore_ordering, is_q78, q78_problematic_col,
+                    max_errors=10, epsilon=0.00001) -> bool:
+    """Row-by-row parity between two query output paths
+    (ref: nds/nds_validate.py:48-114)."""
+    rows1 = _load_rows(input1, input1_format, ignore_ordering)
+    rows2 = _load_rows(input2, input2_format, ignore_ordering)
+    if len(rows1) != len(rows2):
+        print(f"Row counts do not match: {len(rows1)} != {len(rows2)}")
+        return False
+    errors = 0
+    i = 0
+    for lhs, rhs in zip(rows1, rows2):
+        if errors >= max_errors:
+            break
+        if not rowEqual(list(lhs), list(rhs), epsilon, is_q78,
+                        q78_problematic_col):
+            print(f"Row {i}: \n{list(lhs)}\n{list(rhs)}\n")
+            errors += 1
+        i += 1
+    print(f"Processed {i} rows")
+    if errors == max_errors:
+        print(f"Aborting comparison after reaching maximum of {max_errors} errors")
+        return False
+    if errors == 0:
+        print("Results match")
+        return True
+    print(f"There were {errors} errors")
+    return False
+
+
+def iterate_queries(input1, input2, input1_format, input2_format,
+                    ignore_ordering, query_dict, max_errors=10,
+                    epsilon=0.00001, is_float=False):
+    """Compare every query output in the stream; returns the unmatched list
+    (ref: nds/nds_validate.py:217-260 incl. q65/q67 skips)."""
+    unmatch_queries = []
+    for query_name in query_dict.keys():
+        if query_name == "query65":
+            continue
+        if query_name == "query67" and is_float:
+            continue
+        sub_input1 = os.path.join(input1, query_name)
+        sub_input2 = os.path.join(input2, query_name)
+        print(f"=== Comparing Query: {query_name} ===")
+        problematic_col = 2
+        if query_name == "query78":
+            problematic_col = check_nth_col_problematic_q78(query_dict[query_name])
+        if not os.path.exists(sub_input1) or not os.path.exists(sub_input2):
+            print(f"Missing output for {query_name}")
+            unmatch_queries.append(query_name)
+            continue
+        ok = compare_results(sub_input1, sub_input2, input1_format,
+                             input2_format, ignore_ordering,
+                             query_name == "query78", problematic_col,
+                             max_errors=max_errors, epsilon=epsilon)
+        if not ok:
+            unmatch_queries.append(query_name)
+    if unmatch_queries:
+        print(f"=== Unmatch Queries: {unmatch_queries} ===")
+    return unmatch_queries
+
+
+def update_summary(prefix, unmatch_queries, query_dict):
+    """Patch queryValidationStatus into each JSON summary
+    (ref: nds/nds_validate.py:262-296)."""
+    if not os.path.exists(prefix):
+        raise Exception("The json summary folder doesn't exist.")
+    print(f"Updating queryValidationStatus in folder {prefix}.")
+    for query_name in query_dict.keys():
+        summary_wildcard = os.path.join(prefix, f"*{query_name}-*.json")
+        file_glob = glob.glob(summary_wildcard)
+        if len(file_glob) > 1:
+            raise Exception(f"More than one summary file found for query "
+                            f"{query_name} in folder {prefix}.")
+        if len(file_glob) == 0:
+            raise Exception(f"No summary file found for query {query_name} "
+                            f"in folder {prefix}.")
+        for filename in file_glob:
+            with open(filename) as f:
+                summary = json.load(f)
+            if query_name in unmatch_queries:
+                if "Completed" in summary["queryStatus"] or \
+                        "CompletedWithTaskFailures" in summary["queryStatus"]:
+                    summary["queryValidationStatus"] = ["Fail"]
+                else:
+                    summary["queryValidationStatus"] = ["NotAttempted"]
+            else:
+                summary["queryValidationStatus"] = ["Pass"]
+            with open(filename, "w") as f:
+                json.dump(summary, f, indent=2)
+
+
+if __name__ == "__main__":
+    from nds_tpu.power import gen_sql_from_stream, get_query_subset
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("input1", help="path of the first input data")
+    parser.add_argument("input2", help="path of the second input data")
+    parser.add_argument("query_stream_file",
+                        help="query stream file that contains NDS queries in "
+                        "specific order")
+    parser.add_argument("--input1_format", default="parquet",
+                        help="data source format for input1, e.g. parquet, orc")
+    parser.add_argument("--input2_format", default="parquet",
+                        help="data source format for input2, e.g. parquet, orc")
+    parser.add_argument("--max_errors", type=int, default=10,
+                        help="maximum number of differences to report")
+    parser.add_argument("--epsilon", type=float, default=0.00001,
+                        help="allowed relative difference when comparing "
+                        "floating point values")
+    parser.add_argument("--ignore_ordering", action="store_true",
+                        help="sort the data collected from the DataFrames "
+                        "before comparing them")
+    parser.add_argument("--use_iterator", action="store_true",
+                        help="kept for reference CLI parity; outputs are "
+                        "loaded via arrow either way")
+    parser.add_argument("--floats", action="store_true",
+                        help="the input data requires float/double handling "
+                        "(skips query67)")
+    parser.add_argument("--json_summary_folder",
+                        help="path of a folder that contains json summary "
+                        "files to patch with validation status")
+    parser.add_argument("--sub_queries",
+                        type=lambda s: [x.strip() for x in s.split(",")],
+                        help="comma separated list of queries to validate")
+    args = parser.parse_args()
+
+    query_dict = gen_sql_from_stream(args.query_stream_file)
+    if args.sub_queries:
+        query_dict = get_query_subset(query_dict, args.sub_queries)
+    unmatch = iterate_queries(args.input1, args.input2,
+                              args.input1_format, args.input2_format,
+                              args.ignore_ordering, query_dict,
+                              max_errors=args.max_errors,
+                              epsilon=args.epsilon, is_float=args.floats)
+    if args.json_summary_folder:
+        update_summary(args.json_summary_folder, unmatch, query_dict)
+    sys.exit(1 if unmatch else 0)

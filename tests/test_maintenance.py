@@ -1,0 +1,143 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Snapshot warehouse + Data Maintenance + rollback tests (the mutable-table
+layer; ref: nds/nds_maintenance.py, nds/nds_rollback.py)."""
+
+import os
+import sys
+
+import pyarrow as pa
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nds_tpu.warehouse import Warehouse, WarehouseError
+
+
+def _tbl(n, base=0):
+    return pa.table({
+        "k": pa.array(range(base, base + n), type=pa.int64()),
+        "v": pa.array([float(i) for i in range(n)], type=pa.float64()),
+    })
+
+
+class TestWarehouse:
+    def test_create_read_roundtrip(self, tmp_path):
+        w = Warehouse(str(tmp_path))
+        w.create("t", _tbl(5))
+        assert w.read("t").num_rows == 5
+        assert w.tables() == ["t"]
+
+    def test_insert_appends_new_snapshot(self, tmp_path):
+        w = Warehouse(str(tmp_path))
+        w.create("t", _tbl(5))
+        w.insert("t", _tbl(3, base=100))
+        assert w.read("t").num_rows == 8
+        assert [s["id"] for s in w.snapshots("t")] == [0, 1]
+        # time travel: snapshot 0 unchanged
+        assert w.read("t", snapshot_id=0).num_rows == 5
+
+    def test_insert_casts_decimal_rescale(self, tmp_path):
+        w = Warehouse(str(tmp_path))
+        w.create("t", pa.table({"d": pa.array([1], type=pa.decimal128(7, 2))}))
+        wide = pa.table({"d": pa.array([2], type=pa.decimal128(12, 6))})
+        w.insert("t", wide)
+        out = w.read("t")
+        assert out.schema.field("d").type == pa.decimal128(7, 2)
+        assert out.num_rows == 2
+
+    def test_overwrite_and_rollback(self, tmp_path):
+        w = Warehouse(str(tmp_path))
+        w.create("t", _tbl(5))
+        ts_after_create = w.snapshots("t")[-1]["timestamp_ms"]
+        w.overwrite("t", _tbl(2))
+        assert w.read("t").num_rows == 2
+        restored = w.rollback_to_timestamp("t", ts_after_create)
+        assert restored == 0
+        assert w.read("t").num_rows == 5
+        # dropped snapshot file is removed
+        assert [s["id"] for s in w.snapshots("t")] == [0]
+
+    def test_rollback_before_first_snapshot_raises(self, tmp_path):
+        w = Warehouse(str(tmp_path))
+        w.create("t", _tbl(1))
+        with pytest.raises(WarehouseError):
+            w.rollback_to_timestamp("t", 0)
+
+    def test_missing_table_raises(self, tmp_path):
+        w = Warehouse(str(tmp_path))
+        with pytest.raises(WarehouseError):
+            w.read("nope")
+
+
+class TestMaintenanceSQL:
+    """INSERT / DELETE statements routed through the session warehouse
+    (ref: nds/nds_maintenance.py:191-205)."""
+
+    def _session(self, tmp_path):
+        from nds_tpu.engine.session import Session
+        from nds_tpu.engine.column import from_arrow
+        s = Session()
+        w = Warehouse(str(tmp_path))
+        w.create("fact", pa.table({
+            "f_k": pa.array([1, 2, 3, 4], type=pa.int64()),
+            "f_d": pa.array([10, 20, 30, 40], type=pa.int32()),
+        }))
+        s.warehouse = w
+        s.create_temp_view("fact", from_arrow(w.read("fact")))
+        s.create_temp_view("src", pa.table({
+            "s_k": pa.array([7, 8], type=pa.int64()),
+            "s_d": pa.array([70, 80], type=pa.int32()),
+        }))
+        return s, w
+
+    def test_insert_into_via_view(self, tmp_path):
+        s, w = self._session(tmp_path)
+        s.sql("create temp view stage as select s_k as f_k, s_d as f_d from src")
+        s.sql("insert into fact (select * from stage order by f_k)")
+        assert w.read("fact").num_rows == 6
+        assert s.sql("select count(*) from fact").collect()[0][0] == 6
+
+    def test_delete_with_subquery(self, tmp_path):
+        s, w = self._session(tmp_path)
+        s.sql("delete from fact where f_d >= (select min(s_d) from src) - 50")
+        # min(s_d)=70 → threshold 20 → rows with f_d in {20,30,40} deleted
+        assert w.read("fact").num_rows == 1
+        assert s.sql("select f_k from fact").collect() == [(1,)]
+
+    def test_delete_with_in_subquery(self, tmp_path):
+        s, w = self._session(tmp_path)
+        s.create_temp_view("pick", pa.table({
+            "p": pa.array([2, 4], type=pa.int64())}))
+        s.sql("delete from fact where f_k in (select distinct p from pick)")
+        assert sorted(r[0] for r in s.sql("select f_k from fact").collect()) \
+            == [1, 3]
+
+
+class TestMaintenanceDriver:
+    def test_replace_date_orders_dates(self):
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import nds_maintenance as m
+        out = m.replace_date(["x DATE1 y DATE2"],
+                             [("2000-05-02", "2000-05-01")])
+        assert out == ["x 2000-05-01 y 2000-05-02"]
+
+    def test_split_statements_drops_comments(self):
+        import nds_maintenance as m
+        stmts = m.split_statements(
+            "-- header\nCREATE TEMP VIEW v AS\nSELECT 1;\n-- c\nINSERT INTO t "
+            "(SELECT * FROM v);\n")
+        assert len(stmts) == 2
+        assert stmts[0].startswith("CREATE TEMP VIEW")
+        assert stmts[1].startswith("INSERT INTO")
+
+    def test_dm_func_lists_match_reference(self):
+        import nds_maintenance as m
+        assert len(m.INSERT_FUNCS) == 7
+        assert m.DELETE_FUNCS == ["DF_CS", "DF_SS", "DF_WS"]
+        assert m.INVENTORY_DELETE_FUNC == ["DF_I"]
+        # every function has its SQL file shipped
+        folder = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "data_maintenance")
+        for q in m.DM_FUNCS:
+            assert os.path.exists(os.path.join(folder, q + ".sql")), q

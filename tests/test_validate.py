@@ -1,0 +1,116 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Validation-driver semantics tests (ref: nds/nds_validate.py:48-296)."""
+
+import json
+import math
+import os
+import sys
+from decimal import Decimal
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import nds_validate as v
+
+
+class TestCompare:
+    def test_float_epsilon(self):
+        assert v.compare(1.0, 1.0 + 1e-9)
+        assert not v.compare(1.0, 1.001)
+
+    def test_nan_equals_nan(self):
+        assert v.compare(float("nan"), float("nan"))
+
+    def test_none_semantics(self):
+        assert v.compare(None, None)
+        assert not v.compare(None, 1)
+        assert not v.compare(1, None)
+
+    def test_decimal_isclose(self):
+        assert v.compare(Decimal("10.00"), Decimal("10.00"))
+        assert v.compare(Decimal("10.000001"), Decimal("10.000002"),
+                         epsilon=1e-3)
+        assert not v.compare(Decimal("10.00"), Decimal("10.10"))
+
+    def test_exact_for_ints_strings(self):
+        assert v.compare(5, 5)
+        assert not v.compare(5, 6)
+        assert v.compare("a", "a")
+        assert not v.compare("a", "b")
+
+
+class TestRowEqual:
+    def test_plain_row(self):
+        assert v.rowEqual([1, "x", 2.0], [1, "x", 2.0], 1e-5, False, 2)
+        assert not v.rowEqual([1, "x"], [1, "y"], 1e-5, False, 2)
+
+    def test_q78_ratio_tolerance(self):
+        # 2nd column is the rounded ratio: |diff| <= 0.01001 passes
+        assert v.rowEqual([1, 0.50, 9], [1, 0.51, 9], 1e-5, True, 2)
+        assert not v.rowEqual([1, 0.50, 9], [1, 0.52, 9], 1e-5, True, 2)
+
+    def test_q78_none_ratio(self):
+        assert v.rowEqual([1, None, 9], [1, None, 9], 1e-5, True, 2)
+        assert not v.rowEqual([1, None, 9], [1, 0.5, 9], 1e-5, True, 2)
+
+    def test_q78_bad_col_raises(self):
+        try:
+            v.rowEqual([1, 2], [1, 2], 1e-5, True, 3)
+        except Exception:
+            pass
+        else:
+            raise AssertionError("expected exception for col 3")
+
+
+class TestProblematicCol:
+    def test_detects_ratio_column(self):
+        sql = ("select ss_sold_year, round(ss_qty/(coalesce(ws_qty,0)+"
+               "coalesce(cs_qty,0)),2) ratio, ss_qty from x")
+        assert v.check_nth_col_problematic_q78(sql) == 2
+
+
+class TestCompareResults:
+    def _write(self, path, rows):
+        t = pa.table({"a": pa.array([r[0] for r in rows], type=pa.int64()),
+                      "b": pa.array([r[1] for r in rows], type=pa.float64())})
+        os.makedirs(path, exist_ok=True)
+        pq.write_table(t, os.path.join(path, "part-0.parquet"))
+
+    def test_match_and_order_insensitive(self, tmp_path):
+        p1 = str(tmp_path / "q1a")
+        p2 = str(tmp_path / "q1b")
+        self._write(p1, [(1, 1.0), (2, 2.0)])
+        self._write(p2, [(2, 2.0), (1, 1.0)])
+        assert not v.compare_results(p1, p2, "parquet", "parquet",
+                                     ignore_ordering=False, is_q78=False,
+                                     q78_problematic_col=2)
+        assert v.compare_results(p1, p2, "parquet", "parquet",
+                                 ignore_ordering=True, is_q78=False,
+                                 q78_problematic_col=2)
+
+    def test_count_mismatch(self, tmp_path):
+        p1 = str(tmp_path / "q2a")
+        p2 = str(tmp_path / "q2b")
+        self._write(p1, [(1, 1.0)])
+        self._write(p2, [(1, 1.0), (2, 2.0)])
+        assert not v.compare_results(p1, p2, "parquet", "parquet", True,
+                                     False, 2)
+
+
+class TestUpdateSummary:
+    def test_statuses(self, tmp_path):
+        folder = str(tmp_path)
+        for q, status in (("query1", "Completed"), ("query2", "Completed"),
+                          ("query3", "Failed")):
+            with open(os.path.join(folder, f"pfx-{q}-123.json"), "w") as f:
+                json.dump({"queryStatus": [status]}, f)
+        qd = {"query1": "", "query2": "", "query3": ""}
+        v.update_summary(folder, ["query2", "query3"], qd)
+        got = {}
+        for q in qd:
+            with open(os.path.join(folder, f"pfx-{q}-123.json")) as f:
+                got[q] = json.load(f)["queryValidationStatus"]
+        assert got == {"query1": ["Pass"], "query2": ["Fail"],
+                       "query3": ["NotAttempted"]}

@@ -1,0 +1,105 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Coverage sweep: run every query template through the engine on tiny data.
+
+Writes a pass/fail table and groups failures by first error line so planner
+gaps can be burned down in frequency order. Pass `--update-lst` to rewrite
+nds_tpu/queries/templates/supported.lst with the passing set (the ratchet).
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402  (site hook may re-pin the platform; force cpu)
+jax.config.update("jax_platforms", "cpu")
+
+SCALE = os.environ.get("NDS_SWEEP_SCALE", "0.01")
+CACHE = os.path.join(REPO, ".bench_cache", f"sf{SCALE}")
+NDSGEN = os.path.join(REPO, "native", "ndsgen", "ndsgen")
+
+
+def ensure_data():
+    if not os.path.exists(NDSGEN):
+        subprocess.run(["make", "-C", os.path.dirname(NDSGEN)], check=True)
+    marker = os.path.join(CACHE, ".complete")
+    if not os.path.exists(marker):
+        os.makedirs(CACHE, exist_ok=True)
+        subprocess.run([NDSGEN, "-scale", SCALE, "-dir", CACHE], check=True)
+        open(marker, "w").close()
+    return CACHE
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--queries", help="comma list like query5,query14_part1")
+    ap.add_argument("--update-lst", action="store_true")
+    ap.add_argument("--full-trace", action="store_true")
+    args = ap.parse_args()
+
+    from nds_tpu.queries import generate_query_streams, list_templates
+    from nds_tpu.power import gen_sql_from_stream
+    from nds_tpu.engine.session import Session
+    from nds_tpu.schema import get_schemas
+
+    data_dir = ensure_data()
+    stream_dir = os.path.join(REPO, ".bench_cache", "sweep_stream")
+    os.makedirs(stream_dir, exist_ok=True)
+    stream_file = os.path.join(stream_dir, "query_0.sql")
+    generate_query_streams(stream_dir, streams=1, rngseed=19620718)
+
+    queries = gen_sql_from_stream(stream_file)
+    if args.queries:
+        want = set(x.strip() for x in args.queries.split(","))
+        queries = {k: v for k, v in queries.items() if k in want}
+
+    session = Session()
+    schemas = get_schemas(use_decimal=True)
+    for tname, fields in schemas.items():
+        for path in (os.path.join(data_dir, tname),
+                     os.path.join(data_dir, tname + ".dat")):
+            if os.path.exists(path):
+                session.read_raw_view(tname, path, fields)
+                break
+
+    passed, failed = [], {}
+    for qname, qtext in queries.items():
+        t0 = time.perf_counter()
+        try:
+            res = session.sql(qtext)
+            res.collect()
+            ms = (time.perf_counter() - t0) * 1000
+            passed.append((qname, ms))
+            print(f"PASS {qname:22s} {ms:8.1f} ms  rows={res.num_rows}")
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            first = err.splitlines()[0][:110]
+            failed.setdefault(first, []).append(qname)
+            print(f"FAIL {qname:22s} {first}")
+            if args.full_trace:
+                traceback.print_exc()
+
+    print(f"\n=== {len(passed)} passed / {len(passed) + sum(len(v) for v in failed.values())} total ===")
+    for err, qs in sorted(failed.items(), key=lambda kv: -len(kv[1])):
+        print(f"[{len(qs):2d}] {err}\n     {' '.join(qs)}")
+
+    if args.update_lst and passed:
+        lst = os.path.join(REPO, "nds_tpu", "queries", "templates", "supported.lst")
+        # template names, not part names
+        names = sorted({q.split("_part")[0] for q, _ in passed},
+                       key=lambda s: int(s.replace("query", "")))
+        with open(lst, "w") as f:
+            f.write("# queries the engine executes end-to-end (coverage ratchet)\n")
+            for n in names:
+                f.write(n + "\n")
+        print(f"wrote {lst}: {len(names)} templates")
+
+
+if __name__ == "__main__":
+    main()
