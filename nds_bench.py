@@ -1,0 +1,323 @@
+#!/usr/bin/env python3
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Full-benchmark orchestrator.
+
+TPU-build equivalent of the reference orchestrator (ref: nds/nds_bench.py:
+34-507). Runs the 7-step NDS pipeline in TPC-DS spec order, scraping each
+phase's report files (all cross-phase communication stays file-based so any
+phase can be skipped/resumed via the yaml ``skip`` flags):
+
+  0. data generation (raw + per-stream refresh sets)      [untimed]
+  1. Load Test (transcode into the snapshot warehouse)  -> Tld
+  2. query-stream generation (RNGSEED = load end stamp)
+  3. Power Test                                         -> TPower
+  4. Throughput Test 1 (streams 1..n/2)                 -> Ttt1
+  5. Maintenance Test 1                                 -> Tdm1
+  6. Throughput Test 2 (streams n/2+1..n-1)             -> Ttt2
+  7. Maintenance Test 2                                 -> Tdm2
+
+and computes the spec metric
+``int(SF * Sq*99 / (Tpt*Ttt*Tdm*Tld)^(1/4))`` into ``metrics.csv``.
+"""
+
+import argparse
+import math
+import os
+import subprocess
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+PY = sys.executable or "python3"
+
+
+def get_yaml_params(yaml_file):
+    with open(yaml_file, 'r') as f:
+        return yaml.safe_load(f)
+
+
+def get_load_end_timestamp(load_report_file):
+    """RNGSEED for stream generation = load end timestamp from the report
+    (spec 4.3.1; ref: nds/nds_bench.py:60-74)."""
+    with open(load_report_file) as f:
+        for line in f:
+            if "RNGSEED used:" in line:
+                return line.split(":")[1].strip()
+    raise Exception(
+        f"RNGSEED not found in Load Test report file: {load_report_file}")
+
+
+def get_load_time(load_report_file):
+    with open(load_report_file) as f:
+        for line in f:
+            if "Load Test Time" in line:
+                return line.split(":")[1].strip().split(" ")[0]
+    raise Exception(
+        f"Load Test Time not found in Load Test report file: {load_report_file}.")
+
+
+def get_power_time(power_report_file):
+    with open(power_report_file) as f:
+        for line in f:
+            if "Power Test Time" in line:
+                return line.split(",")[2].strip()
+    raise Exception(
+        f"Power Test Time not found in Power Test report file: {power_report_file}.")
+
+
+def get_start_end_time(report_file):
+    start_time = end_time = None
+    with open(report_file) as f:
+        for line in f:
+            if "Power Start Time" in line:
+                start_time = line.split(",")[2].strip()
+            if "Power End Time" in line:
+                end_time = line.split(",")[2].strip()
+    if start_time and end_time:
+        return start_time, end_time
+    raise Exception(
+        f"Start or End time not found in Power Test report file: {report_file}")
+
+
+def get_stream_range(num_streams, first_or_second):
+    """Stream ids for throughput/maintenance test 1 or 2: the generated
+    streams are split in half (ref: nds/nds_bench.py:126-135)."""
+    if first_or_second == 1:
+        return list(range(1, num_streams // 2 + 1))
+    return list(range(num_streams // 2 + 1, num_streams))
+
+
+def get_throughput_time(throughput_report_file_base, num_streams,
+                        first_or_second):
+    """Throughput elapse per Spec 7.4.7.4: max(end) - min(start) across the
+    test's streams (ref: nds/nds_bench.py:138-157)."""
+    start_time, end_time = [], []
+    for stream_num in get_stream_range(num_streams, first_or_second):
+        report_file = throughput_report_file_base + f"_{stream_num}.csv"
+        s, e = get_start_end_time(report_file)
+        start_time.append(float(s))
+        end_time.append(float(e))
+    return round_up_to_nearest_10_percent(max(end_time) - min(start_time))
+
+
+def get_refresh_time(maintenance_report_file):
+    with open(maintenance_report_file) as f:
+        for line in f:
+            if "Data Maintenance Time" in line:
+                return float(line.split(",")[2].strip())
+    raise Exception("Data Maintenance Time not found in Data Maintenance "
+                    f"report file: {maintenance_report_file}.")
+
+
+def get_maintenance_time(maintenance_report_base_path, num_streams,
+                         first_or_second):
+    """Tdm = sum of refresh times across the test's streams
+    (ref: nds/nds_bench.py:176-196)."""
+    Tdm = 0.0
+    for i in get_stream_range(num_streams, first_or_second):
+        Tdm += get_refresh_time(maintenance_report_base_path + f"_{i}.csv")
+    return round_up_to_nearest_10_percent(Tdm)
+
+
+def get_throughput_stream_nums(num_streams, first_or_second):
+    return ",".join(str(x) for x in
+                    get_stream_range(num_streams, first_or_second))
+
+
+def round_up_to_nearest_10_percent(num):
+    """Spec 7.1.16: elapsed times round up to the nearest 0.1s
+    (ref: nds/nds_bench.py:207-208)."""
+    return math.ceil(num * 10) / 10
+
+
+# ----------------------------------------------------------------- phases
+
+def run_data_gen(scale_factor, parallel, data_path, local_or_dist,
+                 num_streams):
+    subprocess.run([PY, os.path.join(REPO, "nds_gen_data.py"), local_or_dist,
+                    scale_factor, parallel, data_path, "--overwrite_output"],
+                   check=True)
+    for i in range(1, num_streams):
+        subprocess.run([PY, os.path.join(REPO, "nds_gen_data.py"),
+                        local_or_dist, scale_factor, parallel,
+                        data_path + f"_{i}", "--overwrite_output",
+                        "--update", str(i)],
+                       check=True)
+
+
+def run_load_test(input_path, output_path, warehouse_type, load_report_file):
+    subprocess.run([PY, os.path.join(REPO, "nds_transcode.py"), input_path,
+                    output_path, load_report_file,
+                    "--output_format", warehouse_type,
+                    "--output_mode", "overwrite"],
+                   check=True)
+
+
+def gen_streams(num_streams, template_dir, scale_factor, stream_output_path,
+                RNGSEED):
+    cmd = [PY, os.path.join(REPO, "nds_gen_query_stream.py")]
+    if template_dir:
+        cmd.append(template_dir)
+    cmd += [scale_factor, stream_output_path,
+            "--rngseed", RNGSEED, "--streams", str(num_streams)]
+    subprocess.run(cmd, check=True)
+
+
+def power_test(input_path, stream_path, report_path, property_path,
+               output_path, warehouse_type, device):
+    cmd = [PY, os.path.join(REPO, "nds_power.py"), input_path, stream_path,
+           report_path, "--input_format", warehouse_type, "--device", device]
+    if property_path:
+        cmd += ["--property_file", property_path]
+    if output_path:
+        cmd += ["--output_prefix", output_path]
+    subprocess.run(cmd, check=True)
+
+
+def throughput_test(num_streams, first_or_second, input_path,
+                    stream_base_path, report_base_path, property_path,
+                    warehouse_type, device):
+    cmd = [os.path.join(REPO, "nds-throughput"),
+           get_throughput_stream_nums(num_streams, first_or_second),
+           PY, os.path.join(REPO, "nds_power.py"), input_path,
+           stream_base_path + "/query_{}.sql", report_base_path + "_{}.csv",
+           "--input_format", warehouse_type, "--device", device]
+    if property_path:
+        cmd += ["--property_file", property_path]
+    print(cmd)
+    subprocess.run(cmd, check=True)
+
+
+def maintenance_test(num_streams, first_or_second, warehouse_path,
+                     maintenance_raw_data_base_path, maintenance_query_path,
+                     maintenance_report_base_path, property_path,
+                     warehouse_type, device):
+    for i in get_stream_range(num_streams, first_or_second):
+        cmd = [PY, os.path.join(REPO, "nds_maintenance.py"), warehouse_path,
+               maintenance_raw_data_base_path + f"_{i}",
+               maintenance_query_path,
+               maintenance_report_base_path + f"_{i}.csv",
+               "--warehouse_type", warehouse_type, "--device", device]
+        if property_path:
+            cmd += ["--property_file", property_path]
+        subprocess.run(cmd, check=True)
+
+
+def get_perf_metric(scale_factor, num_streams_in_throughput, Tload, Tpower,
+                    Ttt1, Ttt2, Tdm1, Tdm2):
+    """Primary metric (spec 7.4.3; ref: nds/nds_bench.py:334-357)."""
+    Q = num_streams_in_throughput * 99
+    Tpt = (Tpower * num_streams_in_throughput) / 3600
+    Ttt = (Ttt1 + Ttt2) / 3600
+    Tdm = (Tdm1 + Tdm2) / 3600
+    Tld = (0.01 * num_streams_in_throughput * Tload) / 3600
+    # float() not int(): sub-1 scale factors are legal in smoke runs
+    return int(float(scale_factor) * Q / (Tpt * Ttt * Tdm * Tld) ** (1 / 4))
+
+
+def write_metrics_report(report_path, metrics_map):
+    with open(report_path, 'w') as f:
+        for key, value in metrics_map.items():
+            f.write(f"{key},{value}\n")
+
+
+def run_full_bench(yaml_params):
+    dg = yaml_params['data_gen']
+    scale_factor = str(dg['scale_factor'])
+    parallel = str(dg['parallel'])
+    raw_data_path = dg['raw_data_path']
+    local_or_dist = dg.get('local_or_dist', dg.get('local_or_hdfs', 'local'))
+    lt = yaml_params['load_test']
+    warehouse_output_path = lt['output_path']
+    warehouse_type = lt['warehouse_type']
+    load_report_path = lt['report_path']
+    gs = yaml_params['generate_query_stream']
+    num_streams = gs['num_streams']
+    query_template_dir = gs.get('query_template_dir')
+    stream_output_path = gs['stream_output_path']
+    power_stream_path = os.path.join(stream_output_path, "query_0.sql")
+    pt = yaml_params['power_test']
+    power_report_path = pt['report_path']
+    power_property_path = pt.get('property_path')
+    power_output_path = pt.get('output_path')
+    device = yaml_params.get('device', 'tpu')
+    tt = yaml_params['throughput_test']
+    throughput_report_base = tt['report_base_path']
+    mt = yaml_params['maintenance_test']
+    maintenance_query_dir = mt['query_dir']
+    maintenance_report_base_path = mt['maintenance_report_base_path']
+    metrics_report = yaml_params['metrics_report_path']
+
+    # 0.
+    if not dg['skip']:
+        run_data_gen(scale_factor, parallel, raw_data_path, local_or_dist,
+                     num_streams)
+    # 1.
+    if not lt['skip']:
+        run_load_test(raw_data_path, warehouse_output_path, warehouse_type,
+                      load_report_path)
+    Tld = round_up_to_nearest_10_percent(float(get_load_time(load_report_path)))
+    # 2.
+    if not gs['skip']:
+        RNGSEED = get_load_end_timestamp(load_report_path)
+        gen_streams(num_streams, query_template_dir, scale_factor,
+                    stream_output_path, RNGSEED)
+    # 3.
+    if not pt['skip']:
+        power_test(warehouse_output_path, power_stream_path,
+                   power_report_path, power_property_path, power_output_path,
+                   warehouse_type, device)
+    # TPower is logged in milliseconds; spec times are seconds rounded up 0.1
+    TPower = round_up_to_nearest_10_percent(
+        float(get_power_time(power_report_path)) / 1000)
+    # 4.
+    if not tt['skip']:
+        throughput_test(num_streams, 1, warehouse_output_path,
+                        stream_output_path, throughput_report_base,
+                        power_property_path, warehouse_type, device)
+    Ttt1 = get_throughput_time(throughput_report_base, num_streams, 1)
+    # 5.
+    if not mt['skip']:
+        maintenance_test(num_streams, 1, warehouse_output_path,
+                         raw_data_path, maintenance_query_dir,
+                         maintenance_report_base_path, power_property_path,
+                         warehouse_type, device)
+    Tdm1 = get_maintenance_time(maintenance_report_base_path, num_streams, 1)
+    # 6.
+    if not tt['skip']:
+        throughput_test(num_streams, 2, warehouse_output_path,
+                        stream_output_path, throughput_report_base,
+                        power_property_path, warehouse_type, device)
+    Ttt2 = get_throughput_time(throughput_report_base, num_streams, 2)
+    # 7.
+    if not mt['skip']:
+        maintenance_test(num_streams, 2, warehouse_output_path,
+                         raw_data_path, maintenance_query_dir,
+                         maintenance_report_base_path, power_property_path,
+                         warehouse_type, device)
+    Tdm2 = get_maintenance_time(maintenance_report_base_path, num_streams, 2)
+
+    perf_metric = get_perf_metric(scale_factor, num_streams // 2, Tld, TPower,
+                                  Ttt1, Ttt2, Tdm1, Tdm2)
+    print(f"====== Performance Metric: {perf_metric} ======")
+    metrics_map = {"scale_factor": scale_factor,
+                   "num_streams": num_streams,
+                   "Tld": Tld,
+                   "TPower": TPower,
+                   "Ttt1": Ttt1,
+                   "Ttt2": Ttt2,
+                   "Tdm1": Tdm1,
+                   "Tdm2": Tdm2,
+                   "perf_metric": perf_metric}
+    write_metrics_report(metrics_report, metrics_map)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument('yaml_config',
+                        help='yaml config file for the benchmark')
+    args = parser.parse_args()
+    run_full_bench(get_yaml_params(args.yaml_config))

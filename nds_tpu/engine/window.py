@@ -18,6 +18,8 @@ from nds_tpu.engine.ops import lexsort_indices, sortable_view
 def _boundaries(cols, order):
     """Sorted-order boundary mask: True where a new run of equal keys starts."""
     n = int(order.shape[0])
+    if n == 0:
+        return jnp.zeros(0, dtype=bool)
     b = jnp.zeros(n, dtype=bool).at[0].set(True)
     for col in cols:
         v = sortable_view(col)
@@ -43,9 +45,12 @@ class WindowContext:
         nl = [False] * len(partition_cols) + list(
             nulls_last or [d for d in (descending or [False] * len(order_cols))])
         self.order = lexsort_indices(all_cols, desc, nl)
-        self.part_boundary = (_boundaries(partition_cols, self.order)
-                              if partition_cols
-                              else jnp.zeros(self.n, dtype=bool).at[0].set(True))
+        if self.n == 0:
+            self.part_boundary = jnp.zeros(0, dtype=bool)
+        elif partition_cols:
+            self.part_boundary = _boundaries(partition_cols, self.order)
+        else:
+            self.part_boundary = jnp.zeros(self.n, dtype=bool).at[0].set(True)
         self.gid_sorted = jnp.cumsum(self.part_boundary) - 1
         self.ngroups = int(self.gid_sorted[-1]) + 1 if self.n else 0
         pos = jnp.arange(self.n)

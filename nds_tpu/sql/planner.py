@@ -375,6 +375,33 @@ class Planner:
                 return (lk2, rk2)
         return None
 
+    def _has_subquery(self, e) -> bool:
+        found = False
+
+        def walk(node):
+            nonlocal found
+            if isinstance(node, (A.ScalarSubquery, A.InSubquery, A.Exists)):
+                found = True
+                return
+            if getattr(node, "query", None) is not None and \
+                    isinstance(getattr(node, "query"), A.Query):
+                found = True
+                return
+            if hasattr(node, "__dataclass_fields__"):
+                for f in vars(node).values():
+                    if isinstance(f, A.Expr):
+                        walk(f)
+                    elif isinstance(f, list):
+                        for x in f:
+                            if isinstance(x, A.Expr):
+                                walk(x)
+                            elif isinstance(x, tuple):
+                                for y in x:
+                                    if isinstance(y, A.Expr):
+                                        walk(y)
+        walk(e)
+        return found
+
     def _column_refs(self, e):
         out = []
 
@@ -457,6 +484,13 @@ class Planner:
             return None
 
         for c in conjuncts:
+            if self._has_subquery(c):
+                # a correlated subquery may reference columns of OTHER parts
+                # (q32: cs_item_sk = i_item_sk inside the scalar subquery);
+                # only the fully joined row has every correlation column in
+                # scope, so never push these down
+                residual.append(c)
+                continue
             tables = self._expr_tables(c, all_cols)
             owners = set()
             for p_i, pc in enumerate(part_cols):
@@ -668,6 +702,7 @@ class Planner:
             for akey, call in agg_calls.items():
                 post.agg_values[akey] = self._compute_agg(
                     call, base_ctx, jnp.zeros(0, dtype=jnp.int64), 0, [])
+            self._eval_windows(sel, post)
             out = self._project(sel, post)
             return out, post
         if len(set_tables) == 1:
@@ -1148,7 +1183,11 @@ class Planner:
             return None
         inner_cols = self._select_output_cols(sel.from_)
         outer_cols = set(ctx.table.column_names)
-        conjs = self._split_conjuncts(sel.where)
+        # hoist common conjuncts out of ORs first: q41's correlation equality
+        # appears as (i_manufact = i1.i_manufact and X) or (i_manufact =
+        # i1.i_manufact and Y)
+        conjs = [h for c in self._split_conjuncts(sel.where)
+                 for h in self._hoist_or_conjuncts(c)]
         corr, keep, residual = [], [], []
         for c in conjs:
             pair = None
