@@ -1,0 +1,151 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Pallas TPU kernels for the hot aggregation path.
+
+The reference delegates its hot operators to the RAPIDS plugin's CUDA
+kernels (SURVEY.md §2.2 N4). Here the hottest device pattern — masked
+grouped aggregation, the inner loop of every GROUP BY query — gets a
+TPU-native Pallas kernel that rides the MXU: a segment-sum is a matmul
+against a one-hot membership matrix, so each (row-tile × group-tile) grid
+cell builds its one-hot block in VMEM with ``broadcasted_iota`` compares and
+accumulates ``w @ onehot`` partial sums on the systolic array. For the group
+counts the same trick runs with unit weights, so one kernel emits both.
+
+This beats a scatter-add lowering when groups are modest (TPC-DS group-bys:
+brands, categories, states — hundreds to tens of thousands of groups) because
+the MXU does 128×128 MACs/cycle while scatter serializes on HBM.
+
+Use :func:`segment_sum_fused` — it picks the Pallas path on TPU (or when
+``NDS_TPU_PALLAS=interpret`` for tests) and falls back to
+``jax.ops.segment_sum`` elsewhere. Values are accumulated in float32 on the
+MXU; the engine's exact int64 decimal path keeps using the XLA fallback
+(int64 matmul does not map to the MXU), mirroring the reference's
+``--floats`` fast path vs exact-decimal split (ref: nds/nds_transcode.py
+--floats, nds/README.md decimal notes).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+try:  # pallas is TPU/experimental; keep the engine importable without it
+    from jax.experimental import pallas as pl
+    _HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    pl = None
+    _HAVE_PALLAS = False
+
+# row tile: sublane-friendly multiple; group tile: one lane width
+_TR = 512
+_TG = 128
+
+
+def _pallas_mode() -> str:
+    """'tpu' | 'interpret' | 'off'."""
+    env = os.environ.get("NDS_TPU_PALLAS", "auto")
+    if env == "off" or not _HAVE_PALLAS:
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    if env in ("auto", "1", "tpu"):
+        try:
+            if jax.default_backend() == "tpu":
+                return "tpu"
+        except RuntimeError:  # pragma: no cover
+            pass
+        return "off"
+    return "off"
+
+
+def _seg_kernel(gid_ref, w_ref, sum_ref, cnt_ref):
+    """One (group-tile j, row-tile i) cell: accumulate this row tile's
+    contribution to this group tile's sums and counts via MXU matmuls.
+
+    The row (reduction) dimension is the INNERMOST grid dim so each output
+    block sees its row tiles on consecutive grid steps — Pallas only keeps an
+    output block's VMEM buffer live across consecutive steps mapping to the
+    same block, so accumulation across a non-innermost reduction dim would
+    read stale buffers on real hardware."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        sum_ref[:] = jnp.zeros_like(sum_ref)
+        cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+    gid = gid_ref[:]                      # (1, TR) int32, -1 = masked row
+    w = w_ref[:].astype(jnp.float32)      # (1, TR)
+    j = pl.program_id(0)
+    gbase = j * _TG
+    # one-hot membership block (TR, TG): rows vs this tile's group ids
+    groups = gbase + jax.lax.broadcasted_iota(jnp.int32, (_TR, _TG), 1)
+    onehot = (gid.reshape(_TR, 1) == groups).astype(jnp.float32)
+    sum_ref[:] += jnp.dot(w, onehot, preferred_element_type=jnp.float32)
+    live = (gid.reshape(1, _TR) >= 0).astype(jnp.float32)
+    cnt_ref[:] += jnp.dot(live, onehot, preferred_element_type=jnp.float32)
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _segment_sum_pallas(gids, weights, num_segments: int, interpret: bool):
+    n = gids.shape[0]
+    n_pad = max(_ceil_to(n, _TR), _TR)
+    g_pad = max(_ceil_to(num_segments, _TG), _TG)
+    # pad rows with gid -1 (matches no group) and zero weight
+    gid_p = jnp.full(n_pad, -1, dtype=jnp.int32).at[:n].set(
+        gids.astype(jnp.int32))
+    w_p = jnp.zeros(n_pad, dtype=jnp.float32).at[:n].set(
+        weights.astype(jnp.float32))
+    grid = (g_pad // _TG, n_pad // _TR)   # rows innermost (see kernel doc)
+    sums, counts = pl.pallas_call(
+        _seg_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _TR), lambda j, i: (0, i)),
+            pl.BlockSpec((1, _TR), lambda j, i: (0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _TG), lambda j, i: (0, j)),
+            pl.BlockSpec((1, _TG), lambda j, i: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, g_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, g_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gid_p.reshape(1, n_pad), w_p.reshape(1, n_pad))
+    return sums[0, :num_segments], counts[0, :num_segments]
+
+
+def pallas_active() -> bool:
+    """True when :func:`segment_sum_fused` will take the Pallas path.
+    Callers must gate on this (not the raw env var) so the exact XLA path is
+    used whenever the kernel itself would fall back."""
+    return _pallas_mode() != "off"
+
+
+def segment_sum_fused(weights, gids, num_segments: int):
+    """(sums f32[G], counts f32[G]) of ``weights`` grouped by ``gids``.
+
+    Rows with gid < 0 are excluded (pre-masked nulls / filtered rows).
+    Pallas MXU path on TPU, XLA segment ops elsewhere.
+    """
+    mode = _pallas_mode()
+    if mode != "off":
+        return _segment_sum_pallas(gids, weights, num_segments,
+                                   mode == "interpret")
+    live = gids >= 0
+    safe = jnp.where(live, gids, 0)
+    w = jnp.where(live, weights.astype(jnp.float32), 0.0)
+    sums = jax.ops.segment_sum(w, safe, num_segments=num_segments)
+    counts = jax.ops.segment_sum(live.astype(jnp.float32), safe,
+                                 num_segments=num_segments)
+    return sums, counts
+
+

@@ -128,6 +128,15 @@ def agg_sum(col: Column, gids, ngroups) -> Column:
     valid = col.valid_mask()
     data = jnp.where(valid, col.data, 0)
     if col.kind == "f64":
+        from nds_tpu.engine.kernels import pallas_active, segment_sum_fused
+        if pallas_active():
+            # opt-in MXU fast path (f32 accumulation; the exact path below is
+            # the default because validation compares at decimal tolerance).
+            # The kernel's counts are per-group valid counts (gid -1 = null),
+            # so they double as the result validity mask.
+            g = jnp.where(valid, gids, -1)
+            sums, counts = segment_sum_fused(data, g, ngroups)
+            return Column("f64", sums.astype(jnp.float64), counts > 0)
         out = jax.ops.segment_sum(data, gids, num_segments=ngroups)
         kind = "f64"
     else:

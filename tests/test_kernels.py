@@ -1,0 +1,76 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Pallas kernel parity tests (interpret mode on the CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nds_tpu.engine import kernels
+
+
+@pytest.fixture()
+def interpret_mode(monkeypatch):
+    monkeypatch.setenv("NDS_TPU_PALLAS", "interpret")
+
+
+def _ref_segment(weights, gids, num_segments):
+    sums = np.zeros(num_segments, dtype=np.float64)
+    counts = np.zeros(num_segments, dtype=np.float64)
+    for w, g in zip(weights, gids):
+        if g >= 0:
+            sums[g] += w
+            counts[g] += 1
+    return sums, counts
+
+
+@pytest.mark.parametrize("n,groups", [(0, 7), (1, 1), (1000, 130), (5000, 513)])
+def test_segment_sum_fused_interpret(interpret_mode, n, groups):
+    rng = np.random.default_rng(3)
+    gids = rng.integers(-1, groups, size=n).astype(np.int32)
+    w = rng.integers(0, 100, size=n).astype(np.float32)
+    sums, counts = kernels.segment_sum_fused(
+        jnp.asarray(w), jnp.asarray(gids), groups)
+    rs, rc = _ref_segment(w, gids, groups)
+    np.testing.assert_allclose(np.asarray(sums), rs, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(counts), rc)
+
+
+def test_segment_sum_fused_fallback_matches(monkeypatch):
+    monkeypatch.setenv("NDS_TPU_PALLAS", "off")
+    rng = np.random.default_rng(4)
+    gids = rng.integers(-1, 50, size=777).astype(np.int32)
+    w = rng.normal(size=777).astype(np.float32)
+    sums, counts = kernels.segment_sum_fused(
+        jnp.asarray(w), jnp.asarray(gids), 50)
+    rs, rc = _ref_segment(w, gids, 50)
+    np.testing.assert_allclose(np.asarray(sums), rs, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(counts), rc)
+
+
+def test_agg_sum_pallas_path_matches_exact(interpret_mode):
+    """The integrated ops.agg_sum fast path vs the exact default path."""
+    from nds_tpu.engine.column import Column
+    from nds_tpu.engine import ops
+    rng = np.random.default_rng(6)
+    n, g = 3000, 200
+    gids = jnp.asarray(rng.integers(0, g, size=n).astype(np.int64))
+    vals = rng.normal(scale=100.0, size=n)
+    valid = rng.random(n) > 0.1
+    col = Column("f64", jnp.asarray(np.where(valid, vals, 0.0)),
+                 jnp.asarray(valid))
+    fast = ops.agg_sum(col, gids, g)
+    import os
+    os.environ["NDS_TPU_PALLAS"] = "off"
+    exact = ops.agg_sum(col, gids, g)
+    np.testing.assert_allclose(np.asarray(fast.data), np.asarray(exact.data),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_array_equal(np.asarray(fast.valid_mask()),
+                                  np.asarray(exact.valid_mask()))
+
+
+def test_pallas_mode_off_without_tpu(monkeypatch):
+    monkeypatch.setenv("NDS_TPU_PALLAS", "auto")
+    if jax.default_backend() != "tpu":
+        assert kernels._pallas_mode() == "off"
