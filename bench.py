@@ -47,15 +47,18 @@ def bench_queries():
     try:
         from nds_tpu.queries import generate_query_streams, SUPPORTED_QUERIES
         from nds_tpu.power import gen_sql_from_stream
-        os.makedirs(qdir, exist_ok=True)
-        stream_file = os.path.join(qdir, "query_0.sql")
-        if not os.path.exists(stream_file):
-            generate_query_streams(qdir, streams=1, rngseed=0,
-                                   templates=SUPPORTED_QUERIES)
-        queries = gen_sql_from_stream(open(stream_file).read())
-        return list(queries.items())
+        if SUPPORTED_QUERIES:
+            os.makedirs(qdir, exist_ok=True)
+            stream_file = os.path.join(qdir, "query_0.sql")
+            if not os.path.exists(stream_file):
+                generate_query_streams(qdir, streams=1, rngseed=0,
+                                       templates=SUPPORTED_QUERIES)
+            queries = gen_sql_from_stream(stream_file)
+            if queries:
+                return list(queries.items())
     except ImportError:
-        return [("query3", """
+        pass
+    return [("query3", """
             select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
                    sum(ss_ext_sales_price) sum_agg
             from date_dim dt, store_sales, item
