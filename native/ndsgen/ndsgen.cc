@@ -836,7 +836,11 @@ static void e_inventory(Row& w, int64_t r) {
   int64_t rem = r % per_week;
   int64_t wh = rem / S->items;
   int64_t item = rem % S->items;
-  w.i(kSalesDateLo + week * 7 + 3);               // Wednesday-ish snapshot date
+  // spread the (possibly sub-SF1-shrunken) snapshot count across the FULL
+  // 5-year window so date-window queries (q21/q22 month ranges) always find
+  // snapshots; at full scale n_weeks == 261 and the stride is exactly 7 days
+  int64_t n_weeks = std::max<int64_t>(1, S->rows[T_INVENTORY] / per_week);
+  w.i(kSalesDateLo + ((week * 261) / n_weeks) * 7 + 3);
   w.i(item + 1);
   w.i(wh + 1);
   w.i_or_null(uni(t, r, 3, 0, 1000), isnull(t, r, 3, 2));
