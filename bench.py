@@ -98,16 +98,19 @@ def main():
 
     baseline_file = os.path.join(REPO, ".bench_baseline.json")
     vs = 1.0
+    base = None
     if os.path.exists(baseline_file):
         try:
             base = json.load(open(baseline_file))
-            if base.get("value"):
-                vs = base["value"] / geomean
-        except (ValueError, KeyError):
-            pass
+        except ValueError:
+            base = None
+    # a baseline only means something for the same query set; re-baseline
+    # whenever the supported-query ratchet grows
+    if base and base.get("n_queries") == len(times) and base.get("value"):
+        vs = base["value"] / geomean
     else:
-        json.dump({"metric": "power_geomean_ms", "value": geomean},
-                  open(baseline_file, "w"))
+        json.dump({"metric": "power_geomean_ms", "value": geomean,
+                   "n_queries": len(times)}, open(baseline_file, "w"))
 
     print(json.dumps({
         "metric": "power_geomean_ms",

@@ -12,6 +12,27 @@ __version__ = "0.1.0"
 
 # The engine's exact-decimal path is int64 fixed point and date arithmetic is
 # 64-bit; x64 must be on before any jax array is created.
+import os as _os  # noqa: E402
+
 import jax as _jax  # noqa: E402
 
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: a Power Run compiles ~100 query pipelines;
+# caching them across processes is the TPU analog of the reference's warmed
+# JVM (ref: nds/README.md Power Run notes). Opt out with NDS_TPU_NO_COMP_CACHE.
+# CPU is excluded: XLA:CPU AOT reload is machine-feature sensitive (SIGILL
+# risk) and the CPU platform only backs tests.
+if not _os.environ.get("NDS_TPU_NO_COMP_CACHE") and \
+        _os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+    try:
+        _cache_dir = _os.environ.get(
+            "NDS_TPU_COMP_CACHE_DIR",
+            _os.path.join(_os.path.expanduser("~"), ".cache", "nds_tpu_xla"))
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        # eager table-at-a-time execution makes many small compilations, so
+        # cache everything (the default 1s floor would skip nearly all of it)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass

@@ -1,0 +1,195 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Distributed query execution over a device mesh.
+
+The scaling recipe (pick a mesh, annotate shardings, let XLA insert the
+collectives) applied to the NDS flagship query shape: scan a row-sharded
+fact table, broadcast-join replicated dimension tables, and merge partial
+aggregates with ``psum`` — the TPU analog of a Spark stage with a broadcast
+hash join feeding a partial/final hash aggregate (the plan RAPIDS lowers for
+q3-class queries; SURVEY.md §2.2 N4, §5.8).
+
+Sharding layout:
+
+- **fact columns**: padded to a multiple of the mesh size and placed with
+  ``NamedSharding(mesh, P('part'))`` — rows ride HBM shards, pad rows carry
+  ``alive=False`` and are masked at the filter (XLA static shapes; the pad
+  is the capacity slack of the exchange design, exchange.py).
+- **dimension columns**: replicated (``P()``) — TPC-DS dimensions are tiny
+  next to facts, so a broadcast join wins over a repartition join exactly as
+  Spark prefers broadcast under ``spark.sql.autoBroadcastJoinThreshold``
+  (ref: nds/power_run_cpu.template:30 broadcastTimeout tuning).
+- **join**: each device probes its fact shard against the replicated
+  dimension hash (searchsorted on sorted keys) — no collective needed.
+- **aggregate**: per-device ``segment_sum`` into the dense group-id space,
+  then ``psum`` over the mesh axis — the all-reduce that replaces the
+  shuffle-to-single-reducer stage.
+
+The generic eager engine stays single-device this round (data-dependent
+shapes force host syncs that would serialize a mesh); this module is the
+distributed path for the filter→broadcast-join→aggregate pipelines that
+dominate the NDS query mix.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nds_tpu.parallel.exchange import make_mesh  # noqa: F401  (re-export)
+
+
+def _pad_to(arr: jnp.ndarray, n: int, fill=0) -> jnp.ndarray:
+    k = n - arr.shape[0]
+    if k == 0:
+        return arr
+    return jnp.concatenate(
+        [arr, jnp.full((k,), fill, dtype=arr.dtype)])
+
+
+def shard_fact_columns(mesh, cols: dict, nrows: int):
+    """Pad each 1-D column to a multiple of the mesh size and shard it
+    row-wise. Returns (sharded_cols, alive_mask) — alive marks real rows."""
+    n_dev = mesh.devices.size
+    n_pad = (nrows + n_dev - 1) // n_dev * n_dev
+    sharding = NamedSharding(mesh, P("part"))
+    out = {}
+    for name, arr in cols.items():
+        out[name] = jax.device_put(_pad_to(arr, n_pad), sharding)
+    alive = jax.device_put(
+        _pad_to(jnp.ones(nrows, dtype=bool), n_pad, False), sharding)
+    return out, alive
+
+
+def replicate(mesh, arr: jnp.ndarray) -> jnp.ndarray:
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+def dim_probe_map(dim_key: jnp.ndarray):
+    """Sorted build side for a broadcast join: returns (sorted_keys, order)
+    so probes are two searchsorteds + a gather."""
+    order = jnp.argsort(dim_key)
+    return jnp.take(dim_key, order), order
+
+
+def broadcast_join_agg(mesh, fact, alive, dim_keys_sorted, dim_order,
+                       dim_payload_codes, num_groups: int,
+                       weight_name: str, fact_key_name: str):
+    """The jitted distributed pipeline: filter (alive mask) -> broadcast-join
+    the fact key against the dimension -> group by the joined dimension
+    payload code -> psum partial aggregates.
+
+    Inner-join semantics: fact rows whose key misses the dimension drop out
+    (weight zeroed), exactly one dimension match per key (FK -> PK join).
+    Returns (sums f64[G], counts i64[G]) replicated on every device.
+    """
+
+    def step(fact_cols, alive_mask, dks, dorder, dcodes):
+        fk = fact_cols[fact_key_name]
+        w = fact_cols[weight_name]
+        lo = jnp.searchsorted(dks, fk, side="left")
+        hi = jnp.searchsorted(dks, fk, side="right")
+        matched = (hi - lo) > 0
+        # payload code of the (unique) matching dimension row
+        didx = jnp.take(dorder, jnp.clip(lo, 0, dks.shape[0] - 1))
+        gid = jnp.take(dcodes, didx)
+        live = alive_mask & matched
+        wz = jnp.where(live, w, jnp.zeros((), dtype=w.dtype))
+        gid_safe = jnp.where(live, gid, 0)
+        sums = jax.ops.segment_sum(
+            wz.astype(jnp.float64), gid_safe, num_segments=num_groups)
+        counts = jax.ops.segment_sum(
+            live.astype(jnp.int64), gid_safe, num_segments=num_groups)
+        return sums, counts
+
+    out_sharding = NamedSharding(mesh, P())
+    jitted = jax.jit(step, out_shardings=(out_sharding, out_sharding))
+    return jitted(fact, alive, dim_keys_sorted, dim_order, dim_payload_codes)
+
+
+def run_distributed_q3(mesh, store_sales, date_dim, item,
+                       manufact_id: int = 128, moy: int = 11):
+    """TPC-DS q3 over the mesh (the minimum end-to-end distributed slice):
+
+        select d_year, i_brand_id, i_brand, sum(ss_ext_sales_price)
+        from date_dim, store_sales, item
+        where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+          and i_manufact_id = [M] and d_moy = [MOY]
+        group by d_year, i_brand_id, i_brand
+
+    ``store_sales``/``date_dim``/``item`` are dicts of host or device int64/
+    int32 arrays (pre-decoded columns). The brand dimension is the group key:
+    group id = item row index (dense, static), filtered after the reduce.
+    Returns host arrays (year, brand_id, brand_code, sum) for matched groups.
+    """
+    n_items = int(item["i_item_sk"].shape[0])
+    n_dates = int(date_dim["d_date_sk"].shape[0])
+
+    # replicated dimension build sides
+    item_keys_sorted, item_order = dim_probe_map(jnp.asarray(item["i_item_sk"]))
+    date_keys_sorted, date_order = dim_probe_map(jnp.asarray(date_dim["d_date_sk"]))
+
+    # dimension predicates fold into the payload: a fact row joins a
+    # "kept" dimension row or contributes nothing
+    keep_item = jnp.asarray(item["i_manufact_id"]) == manufact_id
+    keep_date = jnp.asarray(date_dim["d_moy"]) == moy
+
+    # composite group id: item index × year-slot (years are enumerable)
+    d_year = jnp.asarray(date_dim["d_year"])
+    year_lo = int(jnp.min(d_year))
+    n_years = int(jnp.max(d_year)) - year_lo + 1
+    num_groups = n_items * n_years
+
+    nrows = int(store_sales["ss_item_sk"].shape[0])
+    fact, alive = shard_fact_columns(mesh, {
+        "ss_item_sk": jnp.asarray(store_sales["ss_item_sk"]),
+        "ss_sold_date_sk": jnp.asarray(store_sales["ss_sold_date_sk"]),
+        "ss_ext_sales_price": jnp.asarray(store_sales["ss_ext_sales_price"]),
+    }, nrows)
+
+    def step(fact_cols, alive_mask, iks, iorder, ikeep,
+             dks, dorder, dkeep, dyear):
+        ss_item = fact_cols["ss_item_sk"]
+        ss_date = fact_cols["ss_sold_date_sk"]
+        w = fact_cols["ss_ext_sales_price"]
+
+        ilo = jnp.searchsorted(iks, ss_item, side="left")
+        ihit = (jnp.searchsorted(iks, ss_item, side="right") - ilo) > 0
+        iidx = jnp.take(iorder, jnp.clip(ilo, 0, iks.shape[0] - 1))
+        ilive = ihit & jnp.take(ikeep, iidx)
+
+        dlo = jnp.searchsorted(dks, ss_date, side="left")
+        dhit = (jnp.searchsorted(dks, ss_date, side="right") - dlo) > 0
+        didx = jnp.take(dorder, jnp.clip(dlo, 0, dks.shape[0] - 1))
+        dlive = dhit & jnp.take(dkeep, didx)
+
+        live = alive_mask & ilive & dlive
+        yslot = jnp.take(dyear, didx) - year_lo
+        gid = iidx * n_years + yslot
+        gid_safe = jnp.where(live, gid, 0)
+        wz = jnp.where(live, w, jnp.zeros((), dtype=w.dtype))
+        sums = jax.ops.segment_sum(
+            wz.astype(jnp.float64), gid_safe, num_segments=num_groups)
+        counts = jax.ops.segment_sum(
+            live.astype(jnp.int64), gid_safe, num_segments=num_groups)
+        return sums, counts
+
+    rep = NamedSharding(mesh, P())
+    jitted = jax.jit(step, out_shardings=(rep, rep))
+    sums, counts = jitted(
+        fact, alive, item_keys_sorted, item_order, keep_item,
+        date_keys_sorted, date_order, keep_date, d_year)
+
+    sums = np.asarray(sums)
+    counts = np.asarray(counts)
+    hit = np.nonzero(counts > 0)[0]
+    item_idx = hit // n_years
+    years = hit % n_years + year_lo
+    return {
+        "d_year": years,
+        "i_brand_id": np.asarray(item["i_brand_id"])[item_idx],
+        "item_index": item_idx,
+        "sum_agg": sums[hit],
+        "count": counts[hit],
+    }

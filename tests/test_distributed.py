@@ -1,0 +1,99 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Distributed (mesh) execution vs the single-device engine: same query,
+same data, results must agree — the validation-against-baseline idea
+(SURVEY.md §4.1) applied to the sharded path."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from nds_tpu.parallel import make_mesh
+from nds_tpu.parallel.distributed import (
+    broadcast_join_agg, dim_probe_map, replicate, run_distributed_q3,
+    shard_fact_columns)
+
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 4, reason="needs the virtual multi-device mesh")
+
+
+def _q3_data(rng, n_fact=10_000, n_items=200, n_dates=400):
+    item = {
+        "i_item_sk": np.arange(1, n_items + 1, dtype=np.int64),
+        "i_manufact_id": rng.integers(1, 10, n_items).astype(np.int64),
+        "i_brand_id": rng.integers(1000, 1020, n_items).astype(np.int64),
+    }
+    date_dim = {
+        "d_date_sk": np.arange(1, n_dates + 1, dtype=np.int64),
+        "d_moy": rng.integers(1, 13, n_dates).astype(np.int64),
+        "d_year": 1998 + (np.arange(n_dates, dtype=np.int64) // 100),
+    }
+    store_sales = {
+        # some keys miss the dimensions (null-ish fk -> inner-join drop)
+        "ss_item_sk": rng.integers(1, n_items + 50, n_fact).astype(np.int64),
+        "ss_sold_date_sk": rng.integers(1, n_dates + 30, n_fact).astype(np.int64),
+        "ss_ext_sales_price": rng.integers(1, 10_000, n_fact).astype(np.int64),
+    }
+    return store_sales, date_dim, item
+
+
+def _q3_reference(store_sales, date_dim, item, manufact, moy):
+    """Plain numpy evaluation of the q3 aggregation."""
+    i_by_sk = {int(sk): i for i, sk in enumerate(item["i_item_sk"])}
+    d_by_sk = {int(sk): i for i, sk in enumerate(date_dim["d_date_sk"])}
+    sums = {}
+    for fk, dk, w in zip(store_sales["ss_item_sk"],
+                         store_sales["ss_sold_date_sk"],
+                         store_sales["ss_ext_sales_price"]):
+        ii = i_by_sk.get(int(fk))
+        di = d_by_sk.get(int(dk))
+        if ii is None or di is None:
+            continue
+        if item["i_manufact_id"][ii] != manufact or date_dim["d_moy"][di] != moy:
+            continue
+        key = (int(date_dim["d_year"][di]), ii)
+        sums[key] = sums.get(key, 0) + int(w)
+    return sums
+
+
+@pytest.mark.parametrize("n_fact", [8_000, 8_001])  # even and uneven shards
+def test_distributed_q3_matches_reference(n_fact):
+    rng = np.random.default_rng(11)
+    store_sales, date_dim, item = _q3_data(rng, n_fact=n_fact)
+    manufact, moy = 3, 11
+    mesh = make_mesh(min(8, len(jax.devices())))
+
+    out = run_distributed_q3(mesh, store_sales, date_dim, item,
+                             manufact_id=manufact, moy=moy)
+    ref = _q3_reference(store_sales, date_dim, item, manufact, moy)
+
+    got = {(int(y), int(ii)): float(s)
+           for y, ii, s in zip(out["d_year"], out["item_index"], out["sum_agg"])}
+    assert set(got) == set(ref)
+    for k, v in ref.items():
+        assert got[k] == pytest.approx(float(v))
+
+
+def test_broadcast_join_agg_counts_rows():
+    rng = np.random.default_rng(12)
+    mesh = make_mesh(min(8, len(jax.devices())))
+    n = 4096
+    fact_key = rng.integers(1, 100, n).astype(np.int64)
+    weights = rng.integers(1, 5, n).astype(np.int64)
+    dim_key = np.arange(1, 101, dtype=np.int64)
+    codes = (dim_key % 7).astype(np.int64)
+
+    fact, alive = shard_fact_columns(
+        mesh, {"k": jnp.asarray(fact_key), "w": jnp.asarray(weights)}, n)
+    dks, dorder = dim_probe_map(replicate(mesh, jnp.asarray(dim_key)))
+    sums, counts = broadcast_join_agg(
+        mesh, {"k": fact["k"], "w": fact["w"]}, alive,
+        dks, dorder, replicate(mesh, jnp.asarray(codes)), 7,
+        weight_name="w", fact_key_name="k")
+    assert int(np.asarray(counts).sum()) == n          # every key matches
+    ref = np.zeros(7)
+    for k, w in zip(fact_key, weights):
+        ref[k % 7] += w
+    np.testing.assert_allclose(np.asarray(sums), ref)
