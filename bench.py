@@ -52,7 +52,8 @@ def bench_queries():
             stream_file = os.path.join(qdir, "query_0.sql")
             if not os.path.exists(stream_file):
                 generate_query_streams(qdir, streams=1, rngseed=0,
-                                       templates=SUPPORTED_QUERIES)
+                                       templates=SUPPORTED_QUERIES,
+                                       scale=float(SCALE))
             queries = gen_sql_from_stream(stream_file)
             if queries:
                 return list(queries.items())

@@ -300,6 +300,18 @@ static const char* pick(const char** pool, int n, uint64_t t, uint64_t r, uint64
 }
 #define PK(pool, t, r, c) pick(pool, pool##_n, t, r, c)
 
+// Scale-banded state vocabulary, shared with the query sampler
+// (nds_tpu/queries/__init__.py active_states — keep the bands in sync).
+// The TPC-DS toolkit's fips_county distribution plays the same role: at
+// small scales both dsdgen rows and dsqgen substitutions draw from the same
+// reduced state set, so state-predicate queries stay non-degenerate.
+static int states_active(double sf) {
+  if (sf < 1.0) return 8;
+  if (sf < 100.0) return 16;
+  if (sf < 1000.0) return 32;
+  return 50;
+}
+
 // word-salad sentence for descriptions/comments
 static std::string sentence(uint64_t t, uint64_t r, uint64_t c, int maxwords) {
   int n = 3 + (int)(h4(t, r, c ^ 0x77ULL) % (uint64_t)(maxwords - 2));
@@ -435,6 +447,8 @@ struct Scaling {
   }
 };
 
+static const Scaling* S;  // set in main before any emitter runs
+
 // ---------------------------------------------------------------------------
 // Shared field helpers (address block, money chain)
 // ---------------------------------------------------------------------------
@@ -452,7 +466,7 @@ static void emit_address(Row& w, uint64_t t, uint64_t r, uint64_t c0) {
   w.s(suite);
   w.s(PK(kCities, t, r, c0 + 4));                                    // city
   w.s(PK(kCounties, t, r, c0 + 6));                                  // county
-  const char* st = PK(kStates, t, r, c0 + 7);
+  const char* st = pick(kStates, states_active(S->sf), t, r, c0 + 7);
   w.s(st);                                                           // state
   char zip[8];
   snprintf(zip, sizeof zip, "%05d", (int)uni(t, r, c0 + 8, 10000, 99999));
@@ -497,8 +511,6 @@ static void money_chain(uint64_t t, uint64_t r, Money* m) {
 // ---------------------------------------------------------------------------
 // Dimension emitters: one function per table, row index -> one output line
 // ---------------------------------------------------------------------------
-
-static const Scaling* S;  // set in main before any emitter runs
 
 static void e_customer_address(Row& w, int64_t r) {
   const uint64_t t = T_CUSTOMER_ADDRESS;

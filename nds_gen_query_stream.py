@@ -52,4 +52,5 @@ if __name__ == "__main__":
         streams=int(args.streams) if args.streams else None,
         template=args.template,
         rngseed=int(args.rngseed) if args.rngseed else None,
-        template_dir=template_dir)
+        template_dir=template_dir,
+        scale=float(args.scale))

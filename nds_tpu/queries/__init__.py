@@ -103,6 +103,23 @@ POOLS = {
                        "TWO DAY"],
 }
 
+def active_states(scale: float | None) -> int:
+    """Scale-banded state-vocabulary size, kept in sync with the native
+    generator (native/ndsgen/ndsgen.cc states_active) so state predicates
+    sample values the data actually contains — the role the toolkit's
+    scale-banded fips_county distribution plays for dsdgen+dsqgen."""
+    if scale is None:
+        return len(POOLS["state"])
+    sf = float(scale)
+    if sf < 1.0:
+        return 8
+    if sf < 100.0:
+        return 16
+    if sf < 1000.0:
+        return 32
+    return 50
+
+
 _DEFINE_RE = re.compile(r"^--@\s*(\w+)\s*=\s*(.+?)\s*$", re.MULTILINE)
 _CALL_RE = re.compile(r"^(\w+)\((.*)\)$", re.DOTALL)
 _PLACEHOLDER_RE = re.compile(r"\[(\w+)(?:\.(\d+))\]|\[(\w+)\]")
@@ -141,7 +158,9 @@ def _literal(tok: str):
     return int(tok)
 
 
-def _eval_define(expr: str, rng: np.random.Generator, env: dict):
+def _eval_define(expr: str, rng: np.random.Generator, env: dict,
+                 pools: dict | None = None):
+    pools = POOLS if pools is None else pools
     m = _CALL_RE.match(expr.strip())
     if not m:
         raise ValueError(f"bad template define: {expr}")
@@ -154,12 +173,12 @@ def _eval_define(expr: str, rng: np.random.Generator, env: dict):
         vals = [_literal(a) for a in args]
         return vals[int(rng.integers(0, len(vals)))]
     if fn == "pool":
-        pool = POOLS[args[0]]
+        pool = pools[args[0]]
         return pool[int(rng.integers(0, len(pool)))]
     if fn == "sample":
         k = int(args[0])
         if len(args) == 2:          # sample(k, poolname)
-            pool = POOLS[args[1]]
+            pool = pools[args[1]]
             idx = rng.choice(len(pool), size=min(k, len(pool)), replace=False)
             return [pool[int(i)] for i in idx]
         lo, hi = int(args[1]), int(args[2])   # sample(k, lo, hi)
@@ -178,12 +197,16 @@ def _eval_define(expr: str, rng: np.random.Generator, env: dict):
     raise ValueError(f"unknown template function: {fn}")
 
 
-def instantiate_template(text: str, rng: np.random.Generator) -> str:
+def instantiate_template(text: str, rng: np.random.Generator,
+                         scale: float | None = None) -> str:
     """Resolve the --@ defines and substitute placeholders; returns bare SQL
-    (no defines, no stream markers)."""
+    (no defines, no stream markers). ``scale`` bands the state pool to the
+    vocabulary the generator emits at that scale factor."""
+    pools = dict(POOLS)
+    pools["state"] = POOLS["state"][:active_states(scale)]
     env: dict = {}
     for m in _DEFINE_RE.finditer(text):
-        env[m.group(1)] = _eval_define(m.group(2), rng, env)
+        env[m.group(1)] = _eval_define(m.group(2), rng, env, pools)
     sql = _DEFINE_RE.sub("", text)
 
     def repl(m: re.Match) -> str:
@@ -209,10 +232,12 @@ def load_template(name: str, template_dir: str | None = None) -> str:
 
 
 def _stream_text(order, stream_id: int, rng: np.random.Generator,
-                 template_dir: str | None = None) -> str:
+                 template_dir: str | None = None,
+                 scale: float | None = None) -> str:
     parts = []
     for pos, tpl_name in enumerate(order):
-        sql = instantiate_template(load_template(tpl_name, template_dir), rng)
+        sql = instantiate_template(load_template(tpl_name, template_dir), rng,
+                                   scale)
         head = (f"-- start query {pos + 1} in stream {stream_id} "
                 f"using template {tpl_name}")
         tail = (f"-- end query {pos + 1} in stream {stream_id} "
@@ -227,7 +252,8 @@ def generate_query_streams(output_dir: str, streams: int | None = None,
                            template: str | None = None,
                            rngseed: int | None = None,
                            templates: list | None = None,
-                           template_dir: str | None = None) -> list:
+                           template_dir: str | None = None,
+                           scale: float | None = None) -> list:
     """Write ``query_<i>.sql`` stream files (or a single named query file).
 
     Mirrors dsqgen semantics: ``streams`` permuted full streams, or one
@@ -242,7 +268,7 @@ def generate_query_streams(output_dir: str, streams: int | None = None,
 
     if template is not None:
         rng = np.random.default_rng(seed)
-        text = _stream_text([template], 0, rng, template_dir)
+        text = _stream_text([template], 0, rng, template_dir, scale)
         qname = template[:-4]  # strip .tpl
         if any(str(q) in template for q in SPECIAL_SPLIT):
             part1, part2 = split_special_query(text)
@@ -266,7 +292,7 @@ def generate_query_streams(output_dir: str, streams: int | None = None,
             order = [order[i] for i in rng.permutation(len(order))]
         path = os.path.join(output_dir, f"query_{s}.sql")
         with open(path, "w") as f:
-            f.write(_stream_text(order, s, rng, template_dir))
+            f.write(_stream_text(order, s, rng, template_dir, scale))
         written.append(path)
     return written
 

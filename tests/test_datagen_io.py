@@ -131,3 +131,29 @@ def test_referential_integrity_returns_match_sales(tmp_path):
                         sr["sr_item_sk"].to_pylist()))
     hit = sum(1 for k in ret_keys if k in sales_keys)
     assert hit == len(ret_keys)
+
+
+def test_state_vocabulary_banded_by_scale(tmp_path):
+    """Generator state vocabulary and query-sampler band must agree (the
+    scale-banded fips-distribution idea): at sub-SF1 both sides use the
+    first 8 states, so state predicates stay non-degenerate."""
+    import subprocess
+    from nds_tpu.queries import POOLS, active_states, instantiate_template
+    subprocess.run([NDSGEN, "-scale", "0.01", "-dir", str(tmp_path),
+                    "-table", "customer_address"], check=True)
+    allowed = set(POOLS["state"][:active_states(0.01)])
+    assert len(allowed) == 8
+    states = set()
+    for ln in open(tmp_path / "customer_address.dat", encoding="iso-8859-1"):
+        parts = ln.split("|")
+        if parts[8]:
+            states.add(parts[8])
+    assert states and states <= allowed
+
+    import numpy as np
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        sql = instantiate_template("--@ ST = pool(state)\nselect '[ST]'",
+                                   rng, scale=0.01)
+        got = sql.split("'")[1]
+        assert got in allowed

@@ -52,7 +52,8 @@ def main():
     stream_dir = os.path.join(REPO, ".bench_cache", "sweep_stream")
     os.makedirs(stream_dir, exist_ok=True)
     stream_file = os.path.join(stream_dir, "query_0.sql")
-    generate_query_streams(stream_dir, streams=1, rngseed=19620718)
+    generate_query_streams(stream_dir, streams=1, rngseed=19620718,
+                           scale=float(SCALE))
 
     queries = gen_sql_from_stream(stream_file)
     if args.queries:

@@ -1,0 +1,110 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""End-to-end smoke of the full 7-step benchmark at tiny scale.
+
+Builds a small template subset + bench.yml in a scratch dir, then runs
+nds_bench.py through every phase (data gen -> Load Test -> streams ->
+Power -> Throughput 1 -> Maintenance 1 -> Throughput 2 -> Maintenance 2 ->
+metric). Asserts the metrics.csv lands with a positive composite metric.
+
+Usage: python tools/full_bench_smoke.py [--device cpu|tpu] [--keep]
+"""
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SMOKE_TEMPLATES = ["query3.tpl", "query7.tpl", "query42.tpl", "query52.tpl",
+                   "query55.tpl"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device", default="cpu", choices=["cpu", "tpu"])
+    ap.add_argument("--root", default="/tmp/nds_bench_smoke")
+    ap.add_argument("--scale", default="0.01")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch dir on success")
+    args = ap.parse_args()
+
+    root = os.path.abspath(args.root)
+    if os.path.exists(root):
+        shutil.rmtree(root)
+    os.makedirs(root)
+
+    # template subset (the reference tests with --template single-query runs;
+    # a cut-down templates.lst gives the same effect for whole-pipeline runs)
+    tpl_dir = os.path.join(root, "templates")
+    os.makedirs(tpl_dir)
+    src = os.path.join(REPO, "nds_tpu", "queries", "templates")
+    for name in SMOKE_TEMPLATES:
+        shutil.copy(os.path.join(src, name), os.path.join(tpl_dir, name))
+    with open(os.path.join(tpl_dir, "templates.lst"), "w") as f:
+        f.write("\n".join(SMOKE_TEMPLATES) + "\n")
+
+    cfg = f"""
+device: {args.device}
+data_gen:
+  scale_factor: {args.scale}
+  parallel: 2
+  raw_data_path: {root}/raw
+  local_or_dist: local
+  skip: false
+load_test:
+  output_path: {root}/warehouse
+  warehouse_type: iceberg
+  report_path: {root}/load_test.txt
+  skip: false
+generate_query_stream:
+  num_streams: 5
+  query_template_dir: {tpl_dir}
+  stream_output_path: {root}/streams
+  skip: false
+power_test:
+  report_path: {root}/power_test.csv
+  property_path:
+  output_path:
+  skip: false
+throughput_test:
+  report_base_path: {root}/throughput_report
+  skip: false
+maintenance_test:
+  query_dir: {os.path.join(REPO, 'data_maintenance')}
+  maintenance_report_base_path: {root}/maintenance_report
+  skip: false
+metrics_report_path: {root}/metrics.csv
+"""
+    yml = os.path.join(root, "bench.yml")
+    with open(yml, "w") as f:
+        f.write(cfg)
+
+    env = dict(os.environ)
+    if args.device == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, os.path.join(REPO, "nds_bench.py"),
+                        yml], env=env)
+    if r.returncode != 0:
+        print("FULL BENCH SMOKE: FAILED")
+        sys.exit(1)
+
+    metrics = os.path.join(root, "metrics.csv")
+    assert os.path.exists(metrics), "metrics.csv missing"
+    body = open(metrics).read()
+    print("---- metrics.csv ----")
+    print(body)
+    perf = None
+    for ln in body.splitlines():
+        if ln.startswith("perf_metric"):
+            perf = float(ln.split(",")[1])
+    assert perf is not None and perf > 0, f"bad perf metric: {perf}"
+    print("FULL BENCH SMOKE: OK")
+    if not args.keep:
+        shutil.rmtree(root)
+
+
+if __name__ == "__main__":
+    main()
