@@ -22,9 +22,11 @@ _jax.config.update("jax_enable_x64", True)
 # caching them across processes is the TPU analog of the reference's warmed
 # JVM (ref: nds/README.md Power Run notes). Opt out with NDS_TPU_NO_COMP_CACHE.
 # CPU is excluded: XLA:CPU AOT reload is machine-feature sensitive (SIGILL
-# risk) and the CPU platform only backs tests.
+# risk) and the CPU platform only backs tests. NDS_TPU_COMP_CACHE=force
+# opts CPU in anyway (same-machine dev loops like the coverage sweep).
 if not _os.environ.get("NDS_TPU_NO_COMP_CACHE") and \
-        _os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        (_os.environ.get("NDS_TPU_COMP_CACHE") == "force" or
+         _os.environ.get("JAX_PLATFORMS", "").lower() != "cpu"):
     try:
         _cache_dir = _os.environ.get(
             "NDS_TPU_COMP_CACHE_DIR",

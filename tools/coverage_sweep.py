@@ -17,6 +17,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# same-machine dev loop: persistent compile cache cuts re-sweeps ~3x
+os.environ.setdefault("NDS_TPU_COMP_CACHE", "force")
 import jax  # noqa: E402  (site hook may re-pin the platform; force cpu)
 jax.config.update("jax_platforms", "cpu")
 
@@ -98,7 +100,7 @@ def main():
         with open(lst, "w") as f:
             f.write("# queries the engine executes end-to-end (coverage ratchet)\n")
             for n in names:
-                f.write(n + "\n")
+                f.write(n + ".tpl\n")  # template filenames, ready for streams
         print(f"wrote {lst}: {len(names)} templates")
 
 
