@@ -312,6 +312,12 @@ static int states_active(double sf) {
   return 50;
 }
 
+// same banding idea for the other geographic vocabularies (city/county);
+// capped by each pool's size
+static int geo_active(double sf, int pool_n) {
+  return std::min(pool_n, states_active(sf));
+}
+
 // word-salad sentence for descriptions/comments
 static std::string sentence(uint64_t t, uint64_t r, uint64_t c, int maxwords) {
   int n = 3 + (int)(h4(t, r, c ^ 0x77ULL) % (uint64_t)(maxwords - 2));
@@ -464,8 +470,8 @@ static void emit_address(Row& w, uint64_t t, uint64_t r, uint64_t c0) {
   else
     snprintf(suite, sizeof suite, "Suite %c", (char)('A' + uni(t, r, c0 + 3, 0, 25)));
   w.s(suite);
-  w.s(PK(kCities, t, r, c0 + 4));                                    // city
-  w.s(PK(kCounties, t, r, c0 + 6));                                  // county
+  w.s(pick(kCities, geo_active(S->sf, kCities_n), t, r, c0 + 4));    // city
+  w.s(pick(kCounties, geo_active(S->sf, kCounties_n), t, r, c0 + 6)); // county
   const char* st = pick(kStates, states_active(S->sf), t, r, c0 + 7);
   w.s(st);                                                           // state
   char zip[8];

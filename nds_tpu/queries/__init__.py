@@ -203,7 +203,9 @@ def instantiate_template(text: str, rng: np.random.Generator,
     (no defines, no stream markers). ``scale`` bands the state pool to the
     vocabulary the generator emits at that scale factor."""
     pools = dict(POOLS)
-    pools["state"] = POOLS["state"][:active_states(scale)]
+    k = active_states(scale)
+    for geo in ("state", "city", "county"):   # banded with the generator
+        pools[geo] = POOLS[geo][:min(k, len(POOLS[geo]))]
     env: dict = {}
     for m in _DEFINE_RE.finditer(text):
         env[m.group(1)] = _eval_define(m.group(2), rng, env, pools)

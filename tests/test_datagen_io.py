@@ -143,12 +143,20 @@ def test_state_vocabulary_banded_by_scale(tmp_path):
                     "-table", "customer_address"], check=True)
     allowed = set(POOLS["state"][:active_states(0.01)])
     assert len(allowed) == 8
-    states = set()
+    allowed_city = set(POOLS["city"][:8])
+    allowed_county = set(POOLS["county"][:8])
+    states, cities, counties = set(), set(), set()
     for ln in open(tmp_path / "customer_address.dat", encoding="iso-8859-1"):
         parts = ln.split("|")
         if parts[8]:
             states.add(parts[8])
+        if parts[6]:
+            cities.add(parts[6])
+        if parts[7]:
+            counties.add(parts[7])
     assert states and states <= allowed
+    assert cities and cities <= allowed_city
+    assert counties and counties <= allowed_county
 
     import numpy as np
     rng = np.random.default_rng(0)
