@@ -861,7 +861,13 @@ static void e_inventory(Row& w, int64_t r) {
   w.i(kSalesDateLo + ((week * 261) / n_weeks) * 7 + 3);
   w.i(item + 1);
   w.i(wh + 1);
-  w.i_or_null(uni(t, r, 3, 0, 1000), isnull(t, r, 3, 2));
+  // stockout-skewed on-hand quantity: ~40% of snapshots near zero, the rest
+  // uniform. A pure uniform gives every (item, warehouse) group a coefficient
+  // of variation ~0.58, which degenerates q39's `cov > 1` filter to empty;
+  // stockouts push per-group cov across 1 the way real inventories do.
+  int64_t q = (h4(t, r, 3) % 10) < 4 ? (int64_t)(h4(t, r, 4) % 5)
+                                     : (int64_t)uni(t, r, 5, 0, 1000);
+  w.i_or_null(q, isnull(t, r, 3, 2));
 }
 
 // ---------------------------------------------------------------------------
@@ -994,6 +1000,23 @@ static void derive_cs(int64_t r, CsLine* o) {
   o->warehouse = 1 + (int64_t)(h4(t, (uint64_t)r, 515) % (uint64_t)S->warehouses);
   o->item = 1 + (int64_t)(h4(t, (uint64_t)r, 516) % (uint64_t)S->items);
   o->promo = 1 + (int64_t)(h4(t, (uint64_t)r, 517) % (uint64_t)S->promotions);
+  // Cross-channel repurchase correlation: ~20% of catalog lines are the same
+  // customer re-buying the same item after a store return (what q17/q25/q29
+  // join for: ss -> sr -> cs on customer+item, catalog purchase after the
+  // return). Derived from a store_returns row so the triple exists at every
+  // scale.
+  if (S->rows[T_STORE_RETURNS] > 0 && h4(t, (uint64_t)r, 518) % 5 == 0) {
+    uint64_t j = h4(t, (uint64_t)r, 519) % (uint64_t)S->rows[T_STORE_RETURNS];
+    int64_t sr = (int64_t)j * 10 + (int64_t)(h4(T_STORE_RETURNS, j, 600) % 10);
+    if (sr >= S->rows[T_STORE_SALES]) sr = sr % S->rows[T_STORE_SALES];
+    SsLine L;
+    derive_ss(sr, &L);
+    int64_t ret_date = L.sold_date + 1 + (int64_t)(h4(T_STORE_RETURNS, j, 601) % 120);
+    o->bill_customer = L.customer;
+    o->item = L.item;
+    o->sold_date = std::min<int64_t>(
+        kSalesDateHi, ret_date + (int64_t)(h4(t, (uint64_t)r, 520) % 90));
+  }
   money_chain(t, (uint64_t)r, &o->m);
 }
 
