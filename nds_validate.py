@@ -61,6 +61,15 @@ def compare(expected, actual, epsilon=0.00001):
         return False
     if isinstance(expected, Decimal) and isinstance(actual, Decimal):
         return math.isclose(expected, actual, rel_tol=epsilon)
+    # mixed numeric types (Decimal run vs --floats run): epsilon-compare in
+    # float space; same-type int pairs stay exact via the == fallthrough
+    numeric = (Decimal, float)
+    if isinstance(expected, numeric) and isinstance(actual, (int, *numeric)) \
+            or isinstance(actual, numeric) and isinstance(expected, (int, *numeric)):
+        e, a = float(expected), float(actual)
+        if math.isnan(e) and math.isnan(a):
+            return True
+        return math.isclose(e, a, rel_tol=epsilon)
     return expected == actual
 
 

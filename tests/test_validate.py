@@ -114,3 +114,22 @@ class TestUpdateSummary:
                 got[q] = json.load(f)["queryValidationStatus"]
         assert got == {"query1": ["Pass"], "query2": ["Fail"],
                        "query3": ["NotAttempted"]}
+
+
+class TestMixedNumericCompare:
+    """Decimal run vs --floats run produces mixed-type pairs (the
+    self-validation workflow, tools/self_validate.py)."""
+
+    def test_decimal_vs_float_isclose(self):
+        from decimal import Decimal
+        from nds_validate import compare
+        assert compare(Decimal("1760.16"), 1760.16)
+        assert compare(811.8, Decimal("811.80"))
+        assert not compare(Decimal("1760.16"), 1760.80)
+
+    def test_decimal_vs_int_and_exact_ints(self):
+        from decimal import Decimal
+        from nds_validate import compare
+        assert compare(Decimal("5"), 5)
+        assert compare(5, 5)
+        assert not compare(5, 6)

@@ -1,0 +1,84 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Self-validation: decimal Power Run vs floats Power Run through nds_validate.
+
+The reference's acceptance gate is nds_validate.py comparing a baseline run
+against an accelerated run (SURVEY.md §4.1). With no external engine in the
+image, the same gate runs against this framework's two numeric paths: the
+exact int64 fixed-point decimal path and the float64 path (the reference's
+own --floats escape hatch, ref: nds/README.md decimal notes). Differences
+beyond the float epsilon indicate a real numeric-path bug.
+
+Usage: python tools/self_validate.py [--scale 0.01] [--templates q3,q7,...]
+"""
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_TEMPLATES = ["query3.tpl", "query6.tpl", "query7.tpl", "query42.tpl",
+                     "query43.tpl", "query52.tpl", "query55.tpl", "query96.tpl"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", default="0.01")
+    ap.add_argument("--templates",
+                    help="comma list of template names (default: 8 agg-heavy)")
+    ap.add_argument("--root", default="/tmp/nds_self_validate")
+    ap.add_argument("--device", default="cpu", choices=["cpu", "tpu"])
+    args = ap.parse_args()
+
+    templates = (args.templates.split(",") if args.templates
+                 else DEFAULT_TEMPLATES)
+    root = os.path.abspath(args.root)
+    if os.path.exists(root):
+        shutil.rmtree(root)
+    os.makedirs(root)
+
+    env = dict(os.environ)
+    if args.device == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("NDS_TPU_COMP_CACHE", "force")
+
+    data = os.path.join(root, "raw")
+    os.makedirs(data)
+    subprocess.run([os.path.join(REPO, "native", "ndsgen", "ndsgen"),
+                    "-scale", args.scale, "-dir", data], check=True)
+
+    from nds_tpu.queries import generate_query_streams
+    stream_dir = os.path.join(root, "streams")
+    generate_query_streams(stream_dir, streams=1, rngseed=7,
+                           templates=templates, scale=float(args.scale))
+    stream = os.path.join(stream_dir, "query_0.sql")
+
+    runs = {"decimal": [], "floats": ["--floats"]}
+    for name, extra in runs.items():
+        out = os.path.join(root, f"out_{name}")
+        cmd = [sys.executable, os.path.join(REPO, "nds_power.py"), data,
+               stream, os.path.join(root, f"time_{name}.csv"),
+               "--input_format", "csv", "--output_prefix", out,
+               "--device", args.device] + extra
+        print(f"== power run ({name})")
+        subprocess.run(cmd, check=True, env=env)
+
+    print("== validate")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "nds_validate.py"),
+         os.path.join(root, "out_decimal"), os.path.join(root, "out_floats"),
+         stream, "--ignore_ordering", "--floats", "--epsilon", "0.0001"],
+        env=env)
+    if r.returncode == 0:
+        print("SELF VALIDATION: OK")
+        shutil.rmtree(root)
+    else:
+        print("SELF VALIDATION: MISMATCH (outputs kept at", root, ")")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
