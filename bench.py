@@ -98,11 +98,14 @@ def main():
         if time.perf_counter() - t_start > budget_s:
             skipped += 1
             continue
+        tw = time.perf_counter()
         sess.sql(sql).collect()                      # warmup: compile
         t0 = time.perf_counter()
         res = sess.sql(sql)
         res.collect()
         times[name] = (time.perf_counter() - t0) * 1000.0
+        print(f"# {name}: warm {tw and t0 - tw:.1f}s timed "
+              f"{times[name]/1000:.2f}s", file=sys.stderr)
     if skipped:
         print(f"# budget hit: {skipped} queries skipped", file=sys.stderr)
 
