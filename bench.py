@@ -3,22 +3,29 @@
 """Driver benchmark: Power-Run geomean query time on the available chip.
 
 Generates raw data with the native generator, registers the tables, runs the
-supported TPC-DS query set through the engine (one warm-up pass for
-compilation, then one timed pass — the reference's Power Run times a warmed
+supported TPC-DS query set through the engine (per-query warm-up pass for
+compilation, then a timed pass — the reference's Power Run times a warmed
 JVM the same way), and prints ONE JSON line:
 
     {"metric": "power_geomean_ms", "value": N, "unit": "ms", "vs_baseline": N}
 
-The reference publishes no absolute numbers (BASELINE.md), so ``vs_baseline``
-is reported against this framework's own first recorded value when present
-(``.bench_baseline.json``), else 1.0.
+Fault isolation: queries run in chunked child processes with timeouts, so a
+wedged device RPC or a crash loses only that chunk's remainder, never the
+whole bench (the tunnel to the real chip has been observed to hang a
+blocked-in-C call indefinitely, which in-process watchdogs cannot interrupt).
+
+``vs_baseline`` compares against this framework's own first recorded value
+for the same query-set size (``.bench_baseline.json``); the reference
+publishes no absolute numbers (BASELINE.md).
 """
 
+import argparse
 import json
 import math
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -27,6 +34,9 @@ sys.path.insert(0, REPO)
 SCALE = os.environ.get("NDS_BENCH_SCALE", "0.05")
 CACHE = os.path.join(REPO, ".bench_cache", f"sf{SCALE}")
 NDSGEN = os.path.join(REPO, "native", "ndsgen", "ndsgen")
+CHUNK = int(os.environ.get("NDS_BENCH_CHUNK", "4"))
+# generous per-query allowance: cold compiles on the chip run minutes
+PER_QUERY_TIMEOUT_S = float(os.environ.get("NDS_BENCH_QUERY_TIMEOUT_S", "600"))
 
 
 def ensure_data():
@@ -73,41 +83,68 @@ def bench_queries():
         """)]
 
 
-def main():
+def run_child(names, out_path):
+    """Execute the named queries (warmup + timed) and dump {name: ms}."""
     data_dir = ensure_data()
     from nds_tpu.engine.session import Session
     from nds_tpu.schema import get_schemas
 
-    queries = bench_queries()
-    schemas = get_schemas(use_decimal=True)
+    wanted = dict(bench_queries())
     sess = Session()
-    for table, fields in schemas.items():
+    for table, fields in get_schemas(use_decimal=True).items():
         path = os.path.join(data_dir, f"{table}.dat")
         if os.path.exists(path):
             sess.read_raw_view(table, path, fields)
 
-    # Per-query warmup-then-time (the reference's Power Run times a warmed
-    # JVM the same way). A wall-clock budget guards the driver's bench
-    # window: queries past the budget are skipped and n_queries reports how
-    # many were measured.
-    budget_s = float(os.environ.get("NDS_BENCH_BUDGET_S", "3300"))
-    t_start = time.perf_counter()
     times = {}
-    skipped = 0
-    for name, sql in queries:
-        if time.perf_counter() - t_start > budget_s:
-            skipped += 1
-            continue
+    for name in names:
+        sql = wanted[name]
         tw = time.perf_counter()
         sess.sql(sql).collect()                      # warmup: compile
         t0 = time.perf_counter()
         res = sess.sql(sql)
         res.collect()
         times[name] = (time.perf_counter() - t0) * 1000.0
-        print(f"# {name}: warm {tw and t0 - tw:.1f}s timed "
-              f"{times[name]/1000:.2f}s", file=sys.stderr)
-    if skipped:
-        print(f"# budget hit: {skipped} queries skipped", file=sys.stderr)
+        print(f"# {name}: warm {t0 - tw:.1f}s timed {times[name]/1000:.2f}s",
+              file=sys.stderr)
+        # persist incrementally: a later wedge keeps earlier measurements
+        json.dump(times, open(out_path, "w"))
+    json.dump(times, open(out_path, "w"))
+
+
+def run_parent():
+    ensure_data()                                    # once, before children
+    names = [n for n, _ in bench_queries()]
+    budget_s = float(os.environ.get("NDS_BENCH_BUDGET_S", "3300"))
+    t_start = time.perf_counter()
+    times = {}
+    pending = [names[i:i + CHUNK] for i in range(0, len(names), CHUNK)]
+    for chunk in pending:
+        left = budget_s - (time.perf_counter() - t_start)
+        if left <= 0:
+            break
+        out = tempfile.NamedTemporaryFile(suffix=".json", delete=False).name
+        cmd = [sys.executable, os.path.abspath(__file__), "--child",
+               "--queries", ",".join(chunk), "--out", out]
+        timeout = min(left, PER_QUERY_TIMEOUT_S * len(chunk))
+        try:
+            subprocess.run(cmd, timeout=timeout, check=True)
+        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+            print(f"# chunk {chunk} aborted: {type(e).__name__}",
+                  file=sys.stderr)
+        try:
+            times.update(json.load(open(out)))
+        except (OSError, ValueError):
+            pass
+        os.unlink(out)
+
+    if not times:
+        print(json.dumps({"metric": "power_geomean_ms", "value": None,
+                          "unit": "ms", "vs_baseline": 0.0, "n_queries": 0}))
+        sys.exit(1)
+    if len(times) < len(names):
+        print(f"# measured {len(times)}/{len(names)} queries",
+              file=sys.stderr)
 
     geomean = math.exp(sum(math.log(max(t, 1e-3)) for t in times.values())
                        / len(times))
@@ -135,6 +172,18 @@ def main():
         "vs_baseline": round(vs, 4),
         "n_queries": len(times),
     }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--queries")
+    ap.add_argument("--out")
+    args = ap.parse_args()
+    if args.child:
+        run_child(args.queries.split(","), args.out)
+    else:
+        run_parent()
 
 
 if __name__ == "__main__":
