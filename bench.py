@@ -53,11 +53,15 @@ def ensure_data():
 
 def bench_queries():
     """Supported query set: generated stream when present, else builtin q3."""
-    qdir = os.path.join(REPO, ".bench_cache", "stream")
     try:
         from nds_tpu.queries import generate_query_streams, SUPPORTED_QUERIES
         from nds_tpu.power import gen_sql_from_stream
         if SUPPORTED_QUERIES:
+            # stream cache keyed by scale (predicate vocabularies band by
+            # scale) and by the size of the supported-query ratchet
+            qdir = os.path.join(
+                REPO, ".bench_cache",
+                f"stream_sf{SCALE}_n{len(SUPPORTED_QUERIES)}")
             os.makedirs(qdir, exist_ok=True)
             stream_file = os.path.join(qdir, "query_0.sql")
             if not os.path.exists(stream_file):
@@ -157,11 +161,13 @@ def run_parent():
             base = json.load(open(baseline_file))
         except ValueError:
             base = None
-    # a baseline only means something for the same query set; re-baseline
-    # whenever the supported-query ratchet grows
+    full_run = len(times) == len(names)
     if base and base.get("n_queries") == len(times) and base.get("value"):
         vs = base["value"] / geomean
-    else:
+    elif full_run and (not base or base.get("n_queries") != len(times)):
+        # (re)baseline only on FULL runs: a partial run (wedged chunk /
+        # budget cut) must never clobber the longitudinal baseline, but a
+        # legitimately grown query ratchet re-baselines
         json.dump({"metric": "power_geomean_ms", "value": geomean,
                    "n_queries": len(times)}, open(baseline_file, "w"))
 

@@ -1016,6 +1016,8 @@ static void derive_cs(int64_t r, CsLine* o) {
     o->item = L.item;
     o->sold_date = std::min<int64_t>(
         kSalesDateHi, ret_date + (int64_t)(h4(t, (uint64_t)r, 520) % 90));
+    // ship follows the overridden sale; never before it
+    o->ship_date = o->sold_date + 2 + (int64_t)(h4(t, (uint64_t)r, 502) % 60);
   }
   money_chain(t, (uint64_t)r, &o->m);
 }

@@ -18,16 +18,27 @@ import jax as _jax  # noqa: E402
 
 _jax.config.update("jax_enable_x64", True)
 
-# Persistent XLA compilation cache: a Power Run compiles ~100 query pipelines;
-# caching them across processes is the TPU analog of the reference's warmed
-# JVM (ref: nds/README.md Power Run notes). Opt out with NDS_TPU_NO_COMP_CACHE.
-# CPU is excluded: XLA:CPU AOT reload is machine-feature sensitive (SIGILL
-# risk) and the CPU platform only backs tests. NDS_TPU_COMP_CACHE=force
-# opts CPU in anyway (same-machine dev loops like the coverage sweep).
-if not _os.environ.get("NDS_TPU_NO_COMP_CACHE") and \
-        (_os.environ.get("NDS_TPU_COMP_CACHE") == "force" or
-         _os.environ.get("JAX_PLATFORMS", "").lower() != "cpu"):
+_comp_cache_enabled = False
+
+
+def enable_compile_cache() -> bool:
+    """Enable the persistent XLA compilation cache (idempotent).
+
+    A Power Run compiles ~100 query pipelines; caching them across processes
+    is the TPU analog of the reference's warmed JVM (ref: nds/README.md
+    Power Run notes). Called lazily from Session creation, when the backend
+    is resolved: CPU is excluded because XLA:CPU AOT reload is
+    machine-feature sensitive (SIGILL risk) and the CPU platform only backs
+    tests — NDS_TPU_COMP_CACHE=force opts CPU in anyway (same-machine dev
+    loops like the coverage sweep); NDS_TPU_NO_COMP_CACHE disables entirely.
+    """
+    global _comp_cache_enabled
+    if _comp_cache_enabled or _os.environ.get("NDS_TPU_NO_COMP_CACHE"):
+        return _comp_cache_enabled
     try:
+        if _os.environ.get("NDS_TPU_COMP_CACHE") != "force" and \
+                _jax.default_backend() == "cpu":
+            return False
         _cache_dir = _os.environ.get(
             "NDS_TPU_COMP_CACHE_DIR",
             _os.path.join(_os.path.expanduser("~"), ".cache", "nds_tpu_xla"))
@@ -36,5 +47,7 @@ if not _os.environ.get("NDS_TPU_NO_COMP_CACHE") and \
         # eager table-at-a-time execution makes many small compilations, so
         # cache everything (the default 1s floor would skip nearly all of it)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _comp_cache_enabled = True
     except Exception:  # pragma: no cover - cache is best-effort
         pass
+    return _comp_cache_enabled

@@ -51,6 +51,8 @@ class Result:
 
 class Session:
     def __init__(self, conf: dict | None = None):
+        from nds_tpu import enable_compile_cache
+        enable_compile_cache()   # backend is resolved by session time
         self.conf = dict(conf or {})
         self.catalog: dict[str, DeviceTable] = {}
         self.warehouse = None            # attached by maintenance driver

@@ -94,8 +94,11 @@ def main():
 
     if args.update_lst and passed:
         lst = os.path.join(REPO, "nds_tpu", "queries", "templates", "supported.lst")
-        # template names, not part names
-        names = sorted({q.split("_part")[0] for q, _ in passed},
+        # a template is supported only if NO part of it failed (query14 with
+        # a failing _part2 must not enter the ratchet via a passing _part1)
+        failed_tpls = {q.split("_part")[0]
+                       for qs in failed.values() for q in qs}
+        names = sorted({q.split("_part")[0] for q, _ in passed} - failed_tpls,
                        key=lambda s: int(s.replace("query", "")))
         with open(lst, "w") as f:
             f.write("# queries the engine executes end-to-end (coverage ratchet)\n")
