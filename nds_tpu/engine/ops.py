@@ -59,10 +59,10 @@ def lexsort_indices(cols, descending=None, nulls_last=None) -> jnp.ndarray:
             nullk = jnp.where(col.valid, 0, null_rank_when_null)
             # zero the value under nulls so the value tiebreak is stable
             v = jnp.where(col.valid, v, jnp.zeros((), dtype=v.dtype))
-        else:
-            nullk = jnp.zeros(n, dtype=jnp.int32)
-        # null flag outranks the value within each sort key
-        keys.append(nullk)
+            # null flag outranks the value within each sort key
+            keys.append(nullk)
+        # (a column with no null mask needs no flag key — each flag key is a
+        # whole extra stable-sort pass inside lexsort)
         keys.append(v)
     # jnp.lexsort: last key is primary => reverse (primary-first -> last)
     return jnp.lexsort(tuple(reversed(keys)))
