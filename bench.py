@@ -116,6 +116,26 @@ def run_child(names, out_path):
     json.dump(times, open(out_path, "w"))
 
 
+def resolve_baseline(baseline_file, geomean, n_measured, n_total):
+    """vs_baseline policy: compare only same-sized query sets; (re)baseline
+    only on FULL runs so a partial run (wedged chunk / budget cut) never
+    clobbers the longitudinal baseline, while a legitimately grown query
+    ratchet re-baselines."""
+    base = None
+    if os.path.exists(baseline_file):
+        try:
+            base = json.load(open(baseline_file))
+        except ValueError:
+            base = None
+    if base and base.get("n_queries") == n_measured and base.get("value"):
+        return base["value"] / geomean
+    if n_measured == n_total and (not base or
+                                  base.get("n_queries") != n_measured):
+        json.dump({"metric": "power_geomean_ms", "value": geomean,
+                   "n_queries": n_measured}, open(baseline_file, "w"))
+    return 1.0
+
+
 def run_parent():
     ensure_data()                                    # once, before children
     names = [n for n, _ in bench_queries()]
@@ -153,23 +173,8 @@ def run_parent():
     geomean = math.exp(sum(math.log(max(t, 1e-3)) for t in times.values())
                        / len(times))
 
-    baseline_file = os.path.join(REPO, ".bench_baseline.json")
-    vs = 1.0
-    base = None
-    if os.path.exists(baseline_file):
-        try:
-            base = json.load(open(baseline_file))
-        except ValueError:
-            base = None
-    full_run = len(times) == len(names)
-    if base and base.get("n_queries") == len(times) and base.get("value"):
-        vs = base["value"] / geomean
-    elif full_run and (not base or base.get("n_queries") != len(times)):
-        # (re)baseline only on FULL runs: a partial run (wedged chunk /
-        # budget cut) must never clobber the longitudinal baseline, but a
-        # legitimately grown query ratchet re-baselines
-        json.dump({"metric": "power_geomean_ms", "value": geomean,
-                   "n_queries": len(times)}, open(baseline_file, "w"))
+    vs = resolve_baseline(os.path.join(REPO, ".bench_baseline.json"),
+                          geomean, len(times), len(names))
 
     print(json.dumps({
         "metric": "power_geomean_ms",

@@ -1,0 +1,52 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Bench harness policy tests (no device work)."""
+
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "bench_mod", os.path.join(REPO, "bench.py"))
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+
+
+class TestResolveBaseline:
+    def test_first_full_run_writes_baseline(self, tmp_path):
+        f = tmp_path / "base.json"
+        vs = bench.resolve_baseline(str(f), 100.0, 99, 99)
+        assert vs == 1.0
+        assert json.load(open(f))["n_queries"] == 99
+
+    def test_same_set_compares(self, tmp_path):
+        f = tmp_path / "base.json"
+        bench.resolve_baseline(str(f), 100.0, 99, 99)
+        vs = bench.resolve_baseline(str(f), 50.0, 99, 99)
+        assert vs == 2.0                       # 2x faster than baseline
+
+    def test_partial_run_never_overwrites(self, tmp_path):
+        f = tmp_path / "base.json"
+        bench.resolve_baseline(str(f), 100.0, 99, 99)
+        vs = bench.resolve_baseline(str(f), 10.0, 95, 99)  # wedged chunk
+        assert vs == 1.0                       # not comparable, no clobber
+        assert json.load(open(f))["value"] == 100.0
+        assert bench.resolve_baseline(str(f), 100.0, 99, 99) == 1.0
+
+    def test_ratchet_growth_rebaselines(self, tmp_path):
+        f = tmp_path / "base.json"
+        bench.resolve_baseline(str(f), 100.0, 80, 80)
+        vs = bench.resolve_baseline(str(f), 120.0, 99, 99)  # set grew
+        assert vs == 1.0
+        assert json.load(open(f))["n_queries"] == 99
+
+
+def test_bench_queries_names_match_stream_names():
+    queries = bench.bench_queries()
+    names = [n for n, _ in queries]
+    assert len(names) == len(set(names))
+    assert all(n.startswith("query") for n in names)
+    # the four split templates surface as _part1/_part2 names
+    if len(names) > 1:
+        assert "query14_part1" in names and "query14_part2" in names
