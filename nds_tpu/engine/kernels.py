@@ -123,23 +123,36 @@ def _segment_sum_pallas(gids, weights, num_segments: int, interpret: bool):
     return sums[0, :num_segments], counts[0, :num_segments]
 
 
+_pallas_broken = False
+
+
 def pallas_active() -> bool:
     """True when :func:`segment_sum_fused` will take the Pallas path.
     Callers must gate on this (not the raw env var) so the exact XLA path is
     used whenever the kernel itself would fall back."""
-    return _pallas_mode() != "off"
+    return not _pallas_broken and _pallas_mode() != "off"
 
 
 def segment_sum_fused(weights, gids, num_segments: int):
     """(sums f32[G], counts f32[G]) of ``weights`` grouped by ``gids``.
 
     Rows with gid < 0 are excluded (pre-masked nulls / filtered rows).
-    Pallas MXU path on TPU, XLA segment ops elsewhere.
+    Pallas MXU path on TPU, XLA segment ops elsewhere. Some TPU attachment
+    paths (e.g. tunneled remote-compile backends) cannot compile Mosaic
+    kernels at all; the first such failure permanently flips to the XLA
+    fallback for the process instead of failing the query.
     """
+    global _pallas_broken
     mode = _pallas_mode()
-    if mode != "off":
-        return _segment_sum_pallas(gids, weights, num_segments,
-                                   mode == "interpret")
+    if mode != "off" and not _pallas_broken:
+        try:
+            return _segment_sum_pallas(gids, weights, num_segments,
+                                       mode == "interpret")
+        except Exception as e:  # Mosaic unsupported on this attachment
+            _pallas_broken = True
+            import sys
+            print(f"# pallas kernels disabled ({type(e).__name__}); "
+                  f"using XLA fallback", file=sys.stderr)
     live = gids >= 0
     safe = jnp.where(live, gids, 0)
     w = jnp.where(live, weights.astype(jnp.float32), 0.0)

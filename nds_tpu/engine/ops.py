@@ -73,35 +73,56 @@ def lexsort_indices(cols, descending=None, nulls_last=None) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _dense_codes(v: jnp.ndarray):
+    """Dense group codes of a single 1-D key array (exact, via one
+    single-key stable sort). Returns (codes i64[N], ncodes)."""
+    n = v.shape[0]
+    order = jnp.argsort(v, stable=True)
+    sv = jnp.take(v, order)
+    boundary = jnp.concatenate([jnp.ones(1, dtype=bool), sv[1:] != sv[:-1]])
+    code_sorted = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    codes = jnp.zeros(n, dtype=jnp.int64).at[order].set(code_sorted)
+    return codes, int(code_sorted[-1]) + 1
+
+
 def group_ids(key_cols):
-    """Sort-based grouping.
+    """Grouping by iterative dense re-coding.
 
     Returns (gids, ngroups, rep_indices): per-row dense group id, group count,
     and the row index of each group's first occurrence (for key gathers).
-    SQL GROUP BY treats nulls as equal, which the (null-flag, value) composite
-    keys preserve.
+
+    One single-key sort per key column (+1 to densify each fold) instead of a
+    single k-key lexsort: XLA:TPU compile time for a sort comparator grows
+    superlinearly in operand count, and TPC-DS group-bys reach 8+ key columns
+    (q4's 8-column customer rollup hung the remote compiler outright).
+    SQL GROUP BY treats nulls as equal; each column's code folds its null
+    flag in (``2*value_code + is_null``), so all-null rows share a code
+    distinct from any real value's.
     """
     n = len(key_cols[0])
     if n == 0:
         return jnp.zeros(0, dtype=jnp.int64), 0, jnp.zeros(0, dtype=jnp.int64)
-    order = lexsort_indices(key_cols)
-    boundary = jnp.zeros(n, dtype=bool).at[0].set(True)
+    combined = None
     for col in key_cols:
         v = sortable_view(col)
         if col.valid is not None:
             # zero data under nulls: all-null rows must compare equal
             v = jnp.where(col.valid, v, jnp.zeros((), dtype=v.dtype))
-        sv = jnp.take(v, order)
-        neq = jnp.concatenate([jnp.ones(1, dtype=bool), sv[1:] != sv[:-1]])
+        codes, ncodes = _dense_codes(v)
         if col.valid is not None:
-            nv = jnp.take(col.valid, order)
-            neq = neq | jnp.concatenate([jnp.zeros(1, dtype=bool), nv[1:] != nv[:-1]])
-        boundary = boundary | neq
-    gid_sorted = jnp.cumsum(boundary) - 1
-    ngroups = int(gid_sorted[-1]) + 1
-    gids = jnp.zeros(n, dtype=gid_sorted.dtype).at[order].set(gid_sorted)
-    rep = jnp.take(order, jnp.nonzero(boundary)[0])
-    return gids, ngroups, rep
+            codes = 2 * codes + (~col.valid).astype(jnp.int64)
+        if combined is None:
+            combined = codes
+        else:
+            # fold and immediately re-densify: codes stay < n, so the
+            # product below never exceeds n * (2n+1) (no int64 overflow)
+            prev, nprev = _dense_codes(combined)
+            combined = prev * jnp.int64(2 * ncodes + 1) + codes
+    gids, ngroups = _dense_codes(combined)
+    # first occurrence of each group in row order
+    first = jnp.full(ngroups, n, dtype=jnp.int64).at[gids].min(
+        jnp.arange(n, dtype=jnp.int64))
+    return gids, ngroups, first
 
 
 # ---------------------------------------------------------------------------
