@@ -65,6 +65,9 @@ if __name__ == "__main__":
                         default="tpu",
                         help="execution device; 'cpu' pins the engine to the "
                         "host platform (useful for baseline/validation runs).")
+    parser.add_argument("--profile",
+                        help="folder for per-query device profiler traces "
+                        "(XProf/TensorBoard dumps).")
     args = parser.parse_args()
 
     if args.device == "cpu":
@@ -86,4 +89,5 @@ if __name__ == "__main__":
                      args.output_prefix,
                      args.output_format,
                      args.json_summary_folder,
-                     args.allow_failure)
+                     args.allow_failure,
+                     profile_folder=args.profile)

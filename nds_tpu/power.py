@@ -131,7 +131,8 @@ def run_query_stream(input_prefix: str,
                      output_format: str = "parquet",
                      json_summary_folder: str | None = None,
                      allow_failure: bool = False,
-                     warehouse_type: str | None = None) -> None:
+                     warehouse_type: str | None = None,
+                     profile_folder: str | None = None) -> None:
     """The Power Run loop (ref: nds/nds_power.py:184-322)."""
     from nds_tpu.engine.session import Session
 
@@ -170,8 +171,22 @@ def run_query_stream(input_prefix: str,
     for query_name, q_content in query_dict.items():
         print(f"====== Run {query_name} ======")
         q_report = BenchReport(session)
-        elapsed = q_report.report_on(run_one_query, session, q_content,
-                                     query_name, output_path, output_format)
+        trace_ctx = None
+        if profile_folder:
+            # per-query device trace (XProf/TensorBoard dump) — the TPU
+            # analog of naming the query in the Spark UI via setJobGroup
+            # (ref: nds/nds_power.py:257) plus a real profiler, which the
+            # reference lacks (SURVEY.md §5.1)
+            import jax.profiler as _prof
+            trace_ctx = _prof.trace(os.path.join(profile_folder, query_name))
+            trace_ctx.__enter__()
+        try:
+            elapsed = q_report.report_on(run_one_query, session, q_content,
+                                         query_name, output_path,
+                                         output_format)
+        finally:
+            if trace_ctx is not None:
+                trace_ctx.__exit__(None, None, None)
         print(f"Time taken: [{elapsed}] millis for {query_name}")
         execution_time_list.append((session.app_id, query_name, elapsed))
         q_report.summary["query"] = query_name
