@@ -64,16 +64,26 @@ class Column:
             return jnp.ones(self.data.shape[0], dtype=bool)
         return self.valid
 
-    def null_count(self) -> int:
+    def null_count(self, nrows: int | None = None) -> int:
+        """Nulls among the first ``nrows`` rows (pass the table's logical
+        count for a bucket-padded column — pad slots carry garbage
+        validity)."""
         if self.valid is None:
             return 0
-        return int(jnp.sum(~self.valid))
+        invalid = ~self.valid
+        if nrows is not None and nrows < int(self.data.shape[0]):
+            invalid = invalid & (jnp.arange(self.data.shape[0]) < nrows)
+        return int(jnp.sum(invalid))
 
     def take(self, indices) -> "Column":
+        # clip mode: out-of-range pad indices duplicate a real row, so pad
+        # slots never hold values outside the column's domain (dict codes
+        # stay in range, host-side conversions stay safe)
         return replace(
             self,
-            data=jnp.take(self.data, indices, axis=0),
-            valid=None if self.valid is None else jnp.take(self.valid, indices, axis=0),
+            data=jnp.take(self.data, indices, axis=0, mode="clip"),
+            valid=None if self.valid is None else jnp.take(
+                self.valid, indices, axis=0, mode="clip"),
         )
 
     def with_valid(self, valid) -> "Column":
@@ -120,18 +130,34 @@ def _decimal_to_int64(arr: pa.ChunkedArray, s: int, target_scale: int) -> np.nda
     return out
 
 
-def from_arrow_array(arr, canonical_type: str) -> Column:
-    """One arrow column (Array or ChunkedArray) -> device Column."""
+def _bucket_pad(a: np.ndarray, cap: int):
+    """Zero-pad a host array to the bucket capacity (the padded-prefix
+    invariant: rows past the logical count are ignored garbage). Padding on
+    host keeps raw table lengths out of the device shape universe, so every
+    XLA executable is keyed by a power-of-two bucket."""
+    n = a.shape[0]
+    if n >= cap:
+        return a
+    return np.concatenate([a, np.zeros(cap - n, dtype=a.dtype)])
+
+
+def from_arrow_array(arr, canonical_type: str, cap: int | None = None) -> Column:
+    """One arrow column (Array or ChunkedArray) -> device Column, physically
+    padded to ``cap`` rows when given."""
     from nds_tpu import types as _t
 
     if isinstance(arr, pa.Array):
         arr = pa.chunked_array([arr])
     kind = _t.device_kind(canonical_type)
     n = len(arr)
+    if cap is None:
+        cap = n
     null_count = arr.null_count
     valid_np = None
     if null_count:
-        valid_np = ~np.asarray(pc.is_null(arr).combine_chunks().to_numpy(zero_copy_only=False))
+        valid_np = _bucket_pad(
+            ~np.asarray(pc.is_null(arr).combine_chunks().to_numpy(zero_copy_only=False)),
+            cap)
 
     if kind == "str":
         if not pa.types.is_dictionary(arr.type):
@@ -148,7 +174,7 @@ def from_arrow_array(arr, canonical_type: str) -> Column:
         if values.size == 0:
             values = np.asarray([""], dtype=object)
             codes = np.zeros(n, dtype=np.int32)
-        col = Column("str", jnp.asarray(codes),
+        col = Column("str", jnp.asarray(_bucket_pad(codes, cap)),
                      None if valid_np is None else jnp.asarray(valid_np), values)
         return col
 
@@ -161,7 +187,7 @@ def from_arrow_array(arr, canonical_type: str) -> Column:
             data_np = np.asarray(pc.fill_null(arr, 0).combine_chunks().to_numpy(
                 zero_copy_only=False))
             data_np = np.round(data_np * (10 ** s)).astype(np.int64)
-        return Column(kind, jnp.asarray(data_np),
+        return Column(kind, jnp.asarray(_bucket_pad(data_np, cap)),
                       None if valid_np is None else jnp.asarray(valid_np))
 
     # plain numeric / date / bool
@@ -169,21 +195,26 @@ def from_arrow_array(arr, canonical_type: str) -> Column:
         arr = pc.cast(arr, pa.int32())
     filled = pc.fill_null(arr, 0) if null_count else arr
     np_arr = np.asarray(filled.combine_chunks().to_numpy(zero_copy_only=False))
-    data = jnp.asarray(np_arr.astype(_NUMERIC_DTYPES[kind]))
+    data = jnp.asarray(_bucket_pad(np_arr.astype(_NUMERIC_DTYPES[kind]), cap))
     return Column(kind, data, None if valid_np is None else jnp.asarray(valid_np))
 
 
 def from_arrow(table: pa.Table, canonical_types: dict | None = None):
     """arrow Table -> {name: Column}. ``canonical_types`` overrides the
-    per-column canonical type (defaults to inference from arrow types)."""
+    per-column canonical type (defaults to inference from arrow types).
+    Columns are physically padded to the power-of-two bucket (padded-prefix
+    invariant) so base-table shapes reuse the same XLA executables as every
+    intermediate."""
     from nds_tpu import types as _t
+    from nds_tpu.engine.ops import bucket_len
     from nds_tpu.engine.table import DeviceTable
 
+    cap = bucket_len(table.num_rows)
     cols = {}
     for name in table.column_names:
         ct = (canonical_types or {}).get(name) or _t.arrow_to_canonical(
             table.schema.field(name).type)
-        cols[name] = from_arrow_array(table[name], ct)
+        cols[name] = from_arrow_array(table[name], ct, cap)
     return DeviceTable(cols, table.num_rows)
 
 
@@ -191,7 +222,13 @@ def from_arrow(table: pa.Table, canonical_types: dict | None = None):
 # device -> arrow
 # ---------------------------------------------------------------------------
 
-def column_to_arrow(col: Column) -> pa.Array:
+def column_to_arrow(col: Column, nrows: int | None = None) -> pa.Array:
+    """Device -> arrow; ``nrows`` slices off the bucket-padding suffix
+    (padded-prefix invariant) before the host transfer."""
+    if nrows is not None and nrows < col.data.shape[0]:
+        col = replace(
+            col, data=col.data[:nrows],
+            valid=None if col.valid is None else col.valid[:nrows])
     valid_np = None if col.valid is None else np.asarray(col.valid)
 
     if col.kind == "str":
@@ -230,5 +267,5 @@ def to_arrow(dt) -> pa.Table:
     arrays, names = [], []
     for name, col in dt.columns.items():
         names.append(name)
-        arrays.append(column_to_arrow(col))
+        arrays.append(column_to_arrow(col, dt.nrows))
     return pa.table(arrays, names=names)

@@ -7,6 +7,17 @@ window; SURVEY.md §2.2 N4). All grouping and joining is sort-based on device:
 lexsort + run boundaries + segment reductions — collision-free and
 XLA-friendly (fixed dtypes, gathers, segment ops), with searchsorted probes
 for the join build/probe phases.
+
+Shape discipline: every materialization pads its row count up to a
+power-of-two bucket (:func:`bucket_len`), with valid rows in a prefix
+(``DeviceTable.nrows`` logical rows out of ``plen`` physical). Data past the
+logical count is garbage that every operator ignores: joins hash pad rows to
+unmatchable sentinels, grouping gives them a discardable trailing group, and
+sorts order them last. XLA sees a handful of distinct shapes instead of one
+per intermediate cardinality, so compiled executables are reused across
+queries and across Power Runs via the persistent compilation cache — the
+compile-once-run-many analog of the reference's warmed JVM+plugin
+(ref: nds/nds_power.py:125-135, SURVEY.md §6 hard parts: bucketed padding).
 """
 
 from __future__ import annotations
@@ -17,6 +28,53 @@ import numpy as np
 
 from nds_tpu.engine.column import Column, is_dec
 from nds_tpu.engine.table import DeviceTable
+
+# ---------------------------------------------------------------------------
+# bucketed shapes
+# ---------------------------------------------------------------------------
+
+_MIN_BUCKET = 16
+
+
+def bucket_len(n: int) -> int:
+    """Smallest power-of-two capacity >= n (floor 16)."""
+    if n <= _MIN_BUCKET:
+        return _MIN_BUCKET
+    return 1 << (int(n) - 1).bit_length()
+
+
+def live_mask(plen: int, nrows: int) -> jnp.ndarray:
+    """Bool mask of the logical (non-pad) prefix of a physical array."""
+    return jnp.arange(plen) < nrows
+
+
+def compact_indices(mask: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Indices of the first ``n`` True rows of ``mask``, padded to
+    ``bucket_len(n)`` with an out-of-range fill (gathers clip, scatters
+    drop)."""
+    cap = bucket_len(n)
+    plen = int(mask.shape[0])
+    return jnp.nonzero(mask, size=cap, fill_value=max(plen, 1))[0]
+
+
+def compact_table(table: DeviceTable, mask: jnp.ndarray) -> DeviceTable:
+    """Keep rows where ``mask`` is true, re-bucketing to a prefix-padded
+    table. The single host sync is the row count."""
+    m = mask & live_mask(table.plen, table.nrows)
+    n = int(jnp.sum(m))
+    return take_padded(table, compact_indices(m, n), n)
+
+
+def take_padded(table: DeviceTable, idx: jnp.ndarray, nrows: int) -> DeviceTable:
+    """Gather rows by (possibly out-of-range padded) ``idx``; logical length
+    ``nrows``."""
+    if table.plen == 0:
+        cols = {n: _null_column_like(c, int(idx.shape[0]))
+                for n, c in table.columns.items()}
+        return DeviceTable(cols, 0)
+    cols = {n: c.take(idx) for n, c in table.columns.items()}
+    return DeviceTable(cols, nrows)
+
 
 # ---------------------------------------------------------------------------
 # sort-key preparation
@@ -41,15 +99,20 @@ def sortable_view(col: Column) -> jnp.ndarray:
     return col.data
 
 
-def lexsort_indices(cols, descending=None, nulls_last=None) -> jnp.ndarray:
+def lexsort_indices(cols, descending=None, nulls_last=None,
+                    n_valid: int | None = None) -> jnp.ndarray:
     """Stable multi-key sort. ``cols`` primary-first; per-key descending and
-    nulls-last flags (SQL default: asc, nulls first — Spark semantics)."""
+    nulls-last flags (SQL default: asc, nulls first — Spark semantics).
+    With ``n_valid``, rows past the logical count sort after every live row
+    (the padded-table invariant is preserved by any reorder)."""
     n = len(cols[0])
     if descending is None:
         descending = [False] * len(cols)
     if nulls_last is None:
         nulls_last = [False] * len(cols)
     keys = []  # build primary-first, then reverse for lexsort
+    if n_valid is not None and n_valid < n:
+        keys.append(~live_mask(n, n_valid))   # False (live) first
     for col, desc, nl in zip(cols, descending, nulls_last):
         v = sortable_view(col).astype(jnp.int64) if col.kind != "f64" else sortable_view(col)
         if desc:
@@ -73,23 +136,30 @@ def lexsort_indices(cols, descending=None, nulls_last=None) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _dense_codes(v: jnp.ndarray):
+def _dense_codes(v: jnp.ndarray) -> jnp.ndarray:
     """Dense group codes of a single 1-D key array (exact, via one
-    single-key stable sort). Returns (codes i64[N], ncodes)."""
+    single-key stable sort). Codes are < len(v); no host sync."""
     n = v.shape[0]
     order = jnp.argsort(v, stable=True)
     sv = jnp.take(v, order)
     boundary = jnp.concatenate([jnp.ones(1, dtype=bool), sv[1:] != sv[:-1]])
     code_sorted = jnp.cumsum(boundary.astype(jnp.int64)) - 1
-    codes = jnp.zeros(n, dtype=jnp.int64).at[order].set(code_sorted)
-    return codes, int(code_sorted[-1]) + 1
+    return jnp.zeros(n, dtype=jnp.int64).at[order].set(code_sorted)
 
 
-def group_ids(key_cols):
+_PAD_GROUP_KEY = jnp.iinfo(jnp.int64).max // 2
+
+
+def group_ids(key_cols, n_valid: int | None = None):
     """Grouping by iterative dense re-coding.
 
-    Returns (gids, ngroups, rep_indices): per-row dense group id, group count,
-    and the row index of each group's first occurrence (for key gathers).
+    Returns ``(gids, ngroups, rep_indices, cap)``: per-row dense group id
+    (pad rows land in one trailing, discardable group), the live group
+    count, the (bucket-padded, ``cap``-long) row index of each group's first
+    occurrence, and the bucket capacity every grouped output should be
+    allocated with (``num_segments=cap`` keeps segment-op shapes canonical;
+    pad-group contributions land in output slots past ``ngroups`` or are
+    dropped).
 
     One single-key sort per key column (+1 to densify each fold) instead of a
     single k-key lexsort: XLA:TPU compile time for a sort comparator grows
@@ -97,32 +167,43 @@ def group_ids(key_cols):
     (q4's 8-column customer rollup hung the remote compiler outright).
     SQL GROUP BY treats nulls as equal; each column's code folds its null
     flag in (``2*value_code + is_null``), so all-null rows share a code
-    distinct from any real value's.
+    distinct from any real value's. The fold multiplier is the static bound
+    ``2*plen+2`` (codes are < plen), so no per-fold host sync is needed.
     """
-    n = len(key_cols[0])
-    if n == 0:
-        return jnp.zeros(0, dtype=jnp.int64), 0, jnp.zeros(0, dtype=jnp.int64)
+    plen = len(key_cols[0])
+    if n_valid is None:
+        n_valid = plen
+    if plen == 0:
+        cap = bucket_len(0)
+        return (jnp.zeros(0, dtype=jnp.int64), 0,
+                jnp.full(cap, 1, dtype=jnp.int64), cap)
+    live = live_mask(plen, n_valid)
+    fold = jnp.int64(2 * plen + 2)
     combined = None
     for col in key_cols:
         v = sortable_view(col)
         if col.valid is not None:
             # zero data under nulls: all-null rows must compare equal
             v = jnp.where(col.valid, v, jnp.zeros((), dtype=v.dtype))
-        codes, ncodes = _dense_codes(v)
+        codes = _dense_codes(v)
         if col.valid is not None:
             codes = 2 * codes + (~col.valid).astype(jnp.int64)
         if combined is None:
             combined = codes
         else:
-            # fold and immediately re-densify: codes stay < n, so the
-            # product below never exceeds n * (2n+1) (no int64 overflow)
-            prev, nprev = _dense_codes(combined)
-            combined = prev * jnp.int64(2 * ncodes + 1) + codes
-    gids, ngroups = _dense_codes(combined)
-    # first occurrence of each group in row order
-    first = jnp.full(ngroups, n, dtype=jnp.int64).at[gids].min(
-        jnp.arange(n, dtype=jnp.int64))
-    return gids, ngroups, first
+            # fold and immediately re-densify: both operands stay < 2*plen+2,
+            # so the product below never overflows int64
+            combined = _dense_codes(combined) * fold + codes
+    # pad rows form one trailing group (the sort key exceeds any real code)
+    combined = jnp.where(live, combined, _PAD_GROUP_KEY)
+    gids = _dense_codes(combined)
+    ngroups = int(jnp.max(jnp.where(live, gids, -1))) + 1  # the one host sync
+    cap = bucket_len(ngroups)
+    # first occurrence of each live group in row order; pad group dropped
+    scatter_ids = jnp.where(live, gids, cap)
+    rep = jnp.full(cap, plen, dtype=jnp.int64).at[scatter_ids].min(
+        jnp.arange(plen, dtype=jnp.int64))
+    return gids, ngroups, rep, cap
 
 
 # ---------------------------------------------------------------------------
@@ -136,7 +217,9 @@ _I64_MAX = jnp.iinfo(jnp.int64).max
 
 
 def agg_count(col: Column | None, gids, ngroups) -> Column:
-    """count(*) when col is None else count(col) (non-null)."""
+    """count(*) when col is None else count(col) (non-null). Pad rows need
+    no masking here: grouping routes them to a trailing group that lands
+    past the logical group count or is dropped by the segment op."""
     if col is None:
         ones = jnp.ones(gids.shape[0], dtype=jnp.int64)
     else:
@@ -227,8 +310,7 @@ def filter_table(table: DeviceTable, predicate: Column) -> DeviceTable:
     mask = predicate.data.astype(bool)
     if predicate.valid is not None:
         mask = mask & predicate.valid
-    idx = jnp.nonzero(mask)[0]
-    return table.take(idx)
+    return compact_table(table, mask)
 
 
 # ---------------------------------------------------------------------------
@@ -246,13 +328,15 @@ def _mix64(x: jnp.ndarray) -> jnp.ndarray:
     return x ^ (x >> 31)
 
 
-def _key_hash(cols, side_salt: int, null_safe: bool = False) -> jnp.ndarray:
+def _key_hash(cols, side_salt: int, null_safe: bool = False,
+              n_valid: int | None = None) -> jnp.ndarray:
     """64-bit composite hash of the key columns.
 
     Default SQL join semantics: rows with any null key get a per-row unique
     value that cannot match the other side (null joins nothing). With
     ``null_safe`` (set operations, null-safe equality), the null flag is
-    folded into the hash instead so null keys compare equal."""
+    folded into the hash instead so null keys compare equal. Pad rows past
+    ``n_valid`` always get the unmatchable per-row value."""
     n = len(cols[0])
     h = jnp.full(n, jnp.uint64(0x243F6A8885A308D3), dtype=jnp.uint64)
     any_null = jnp.zeros(n, dtype=bool)
@@ -272,11 +356,12 @@ def _key_hash(cols, side_salt: int, null_safe: bool = False) -> jnp.ndarray:
             marker = jnp.zeros(n, dtype=jnp.uint64)
         h = _mix64(h ^ marker)
         h = _mix64(h ^ v * jnp.uint64(_HASH_C1))
-    if null_safe:
-        return h | jnp.uint64(4)
+    unmatchable = jnp.zeros(n, dtype=bool) if null_safe else any_null
+    if n_valid is not None and n_valid < n:
+        unmatchable = unmatchable | ~live_mask(n, n_valid)
     row_ids = jnp.arange(n, dtype=jnp.uint64)
     sentinel = jnp.uint64(1 if side_salt else 2) + (row_ids << jnp.uint64(2))
-    return jnp.where(any_null, sentinel, h | jnp.uint64(4))
+    return jnp.where(unmatchable, sentinel, h | jnp.uint64(4))
 
 
 def _verify_pairs(l_idx, r_idx, left_keys, right_keys,
@@ -337,53 +422,77 @@ def ordered_codes_merged(a: Column, b: Column):
 
 
 def join_indices(left_keys, right_keys, how: str = "inner",
-                 null_safe: bool = False):
-    """Equi-join. Returns (l_idx, r_idx, l_extra, r_extra):
-    matched pair indices plus (for outer joins) the unmatched row indices of
-    each side to be padded with nulls.
+                 null_safe: bool = False,
+                 n_left: int | None = None, n_right: int | None = None):
+    """Equi-join. Returns ``(l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra,
+    n_rx)``: bucket-padded matched pair indices with their logical count,
+    plus (for outer joins) the bucket-padded unmatched row indices of each
+    side. Pad slots hold out-of-range indices (gathers clip, scatters drop).
     """
-    n_left = len(left_keys[0])
-    n_right = len(right_keys[0])
-    lh = _key_hash(left_keys, 0, null_safe)
-    rh = _key_hash(right_keys, 1, null_safe)
+    plen_l = len(left_keys[0])
+    plen_r = len(right_keys[0])
+    n_left = plen_l if n_left is None else n_left
+    n_right = plen_r if n_right is None else n_right
+    lh = _key_hash(left_keys, 0, null_safe, n_left)
+    rh = _key_hash(right_keys, 1, null_safe, n_right)
     order = jnp.argsort(rh)
     rh_sorted = jnp.take(rh, order)
     lo = jnp.searchsorted(rh_sorted, lh, side="left")
     hi = jnp.searchsorted(rh_sorted, lh, side="right")
     counts = hi - lo
-    total = int(jnp.sum(counts))
+    total = int(jnp.sum(counts))                       # host sync 1
     if total > 0:
-        l_idx = jnp.repeat(jnp.arange(n_left), counts, total_repeat_length=total)
+        cand = bucket_len(total)
+        l_idx = jnp.repeat(jnp.arange(plen_l), counts, total_repeat_length=cand)
         starts = jnp.cumsum(counts) - counts
-        pos = jnp.arange(total) - jnp.repeat(starts, counts, total_repeat_length=total)
-        r_pos = jnp.repeat(lo, counts, total_repeat_length=total) + pos
-        r_idx = jnp.take(order, r_pos)
+        pos = jnp.arange(cand) - jnp.repeat(starts, counts, total_repeat_length=cand)
+        r_pos = jnp.repeat(lo, counts, total_repeat_length=cand) + pos
+        r_idx = jnp.take(order, jnp.clip(r_pos, 0, max(plen_r - 1, 0)))
+        pair_live = live_mask(cand, total)
         ok = _verify_pairs(l_idx, r_idx, left_keys, right_keys, null_safe)
-        keep = jnp.nonzero(ok)[0]
-        l_idx = jnp.take(l_idx, keep)
-        r_idx = jnp.take(r_idx, keep)
+        ok = ok & pair_live
+        n_pairs = int(jnp.sum(ok))                     # host sync 2
+        keep = jnp.nonzero(ok, size=bucket_len(n_pairs), fill_value=cand)[0]
+        # out-of-range pads: point pad pairs past both inputs
+        l_idx = jnp.take(l_idx, keep, mode="fill", fill_value=plen_l)
+        r_idx = jnp.take(r_idx, keep, mode="fill", fill_value=plen_r)
     else:
-        l_idx = jnp.zeros(0, dtype=jnp.int64)
-        r_idx = jnp.zeros(0, dtype=jnp.int64)
+        n_pairs = 0
+        cap0 = bucket_len(0)
+        l_idx = jnp.full(cap0, plen_l, dtype=jnp.int64)
+        r_idx = jnp.full(cap0, plen_r, dtype=jnp.int64)
 
     l_extra = r_extra = None
+    n_lx = n_rx = 0
     if how in ("left", "full"):
-        matched = jnp.zeros(n_left, dtype=bool).at[l_idx].set(True)
-        l_extra = jnp.nonzero(~matched)[0]
+        matched = jnp.zeros(plen_l, dtype=bool).at[l_idx].set(
+            True, mode="drop")
+        miss = ~matched & live_mask(plen_l, n_left)
+        n_lx = int(jnp.sum(miss))
+        l_extra = compact_indices(miss, n_lx)
     if how in ("right", "full"):
-        matched_r = jnp.zeros(n_right, dtype=bool).at[r_idx].set(True)
-        r_extra = jnp.nonzero(~matched_r)[0]
-    return l_idx, r_idx, l_extra, r_extra
+        matched_r = jnp.zeros(plen_r, dtype=bool).at[r_idx].set(
+            True, mode="drop")
+        miss_r = ~matched_r & live_mask(plen_r, n_right)
+        n_rx = int(jnp.sum(miss_r))
+        r_extra = compact_indices(miss_r, n_rx)
+    return l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx
 
 
 def semi_join_mask(left_keys, right_keys, negate: bool = False,
-                   null_safe: bool = False) -> jnp.ndarray:
+                   null_safe: bool = False,
+                   n_left: int | None = None,
+                   n_right: int | None = None) -> jnp.ndarray:
     """Boolean per-left-row mask: has (semi) / lacks (anti) a match on the
-    right. Used for IN / EXISTS / NOT EXISTS and (null-safe) set ops."""
-    l_idx, _, _, _ = join_indices(left_keys, right_keys, "inner", null_safe)
-    n_left = len(left_keys[0])
-    matched = jnp.zeros(n_left, dtype=bool).at[l_idx].set(True)
-    return ~matched if negate else matched
+    right. Used for IN / EXISTS / NOT EXISTS and (null-safe) set ops.
+    Pad rows always come back False."""
+    plen_l = len(left_keys[0])
+    n_left = plen_l if n_left is None else n_left
+    l_idx, _, _, _, _, _, _ = join_indices(
+        left_keys, right_keys, "inner", null_safe, n_left, n_right)
+    matched = jnp.zeros(plen_l, dtype=bool).at[l_idx].set(True, mode="drop")
+    out = ~matched if negate else matched
+    return out & live_mask(plen_l, n_left)
 
 
 def _null_column_like(col: Column, n: int) -> Column:
@@ -395,27 +504,24 @@ def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
                 how: str = "inner") -> DeviceTable:
     """Materialized equi-join of two tables; column name collisions must be
     resolved by the caller (planner aliases)."""
-    l_idx, r_idx, l_extra, r_extra = join_indices(
-        [left[c] for c in left_on], [right[c] for c in right_on], how)
-    out = {}
-    n_matched = int(l_idx.shape[0])
-    n_lx = 0 if l_extra is None else int(l_extra.shape[0])
-    n_rx = 0 if r_extra is None else int(r_extra.shape[0])
-    for name, col in left.columns.items():
-        parts = [col.take(l_idx)]
-        if n_lx:
-            parts.append(col.take(l_extra))
-        if n_rx:
-            parts.append(_null_column_like(col, n_rx))
-        out[name] = concat_columns(parts)
-    for name, col in right.columns.items():
-        parts = [col.take(r_idx)]
-        if n_lx:
-            parts.append(_null_column_like(col, n_lx))
-        if n_rx:
-            parts.append(col.take(r_extra))
-        out[name] = concat_columns(parts)
-    return DeviceTable(out, n_matched + n_lx + n_rx)
+    l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx = join_indices(
+        [left[c] for c in left_on], [right[c] for c in right_on], how,
+        n_left=left.nrows, n_right=right.nrows)
+    matched = DeviceTable(
+        {**{n: c.take(l_idx) for n, c in left.columns.items()},
+         **{n: c.take(r_idx) for n, c in right.columns.items()}}, n_pairs)
+    parts = [matched]
+    if l_extra is not None and n_lx:
+        cols = {n: c.take(l_extra) for n, c in left.columns.items()}
+        cols.update({n: _null_column_like(c, int(l_extra.shape[0]))
+                     for n, c in right.columns.items()})
+        parts.append(DeviceTable(cols, n_lx))
+    if r_extra is not None and n_rx:
+        cols = {n: _null_column_like(c, int(r_extra.shape[0]))
+                for n, c in left.columns.items()}
+        cols.update({n: c.take(r_extra) for n, c in right.columns.items()})
+        parts.append(DeviceTable(cols, n_rx))
+    return concat_tables(parts) if len(parts) > 1 else matched
 
 
 # ---------------------------------------------------------------------------
@@ -454,9 +560,19 @@ def _concat_valids(cols):
 
 
 def concat_tables(tables) -> DeviceTable:
+    """UNION ALL. Physical concatenation interleaves each part's pad rows, so
+    the result is re-compacted back to prefix-padded form; the logical counts
+    are already known on host, so this costs no sync."""
     names = tables[0].column_names
     out = {n: concat_columns([t[n] for t in tables]) for n in names}
-    return DeviceTable(out, sum(t.nrows for t in tables))
+    total = sum(t.nrows for t in tables)
+    live = jnp.concatenate(
+        [live_mask(t.plen, t.nrows) for t in tables])
+    raw = DeviceTable(out, total)
+    if total == int(live.shape[0]):
+        return raw                                    # no pads anywhere
+    idx = compact_indices(live, total)
+    return take_padded(raw, idx, total)
 
 
 # ---------------------------------------------------------------------------
@@ -466,10 +582,17 @@ def concat_tables(tables) -> DeviceTable:
 
 def sort_table(table: DeviceTable, keys, descending=None, nulls_last=None) -> DeviceTable:
     order = lexsort_indices([table[k] if isinstance(k, str) else k for k in keys],
-                            descending, nulls_last)
-    return table.take(order)
+                            descending, nulls_last, n_valid=table.nrows)
+    cols = {n: c.take(order) for n, c in table.columns.items()}
+    return DeviceTable(cols, table.nrows)
 
 
 def limit_table(table: DeviceTable, n: int) -> DeviceTable:
-    idx = jnp.arange(min(n, table.nrows))
-    return table.take(idx)
+    """First ``n`` logical rows (callers sort first; pads always trail)."""
+    new_n = min(n, table.nrows)
+    cap = bucket_len(new_n)
+    if cap >= table.plen:
+        return DeviceTable(dict(table.columns), new_n)
+    idx = jnp.arange(cap)
+    cols = {nm: c.take(idx) for nm, c in table.columns.items()}
+    return DeviceTable(cols, new_n)

@@ -111,16 +111,16 @@ class Session:
                 raise ExecError("DELETE requires an attached warehouse")
             # evaluate the predicate against the current table; delete by mask
             import jax.numpy as jnp
-            from nds_tpu.sql.planner import EvalCtx
+            from nds_tpu.engine import ops as E
             table = self.catalog[stmt.table.lower()]
             aliased = planner._alias_table(table, stmt.table)
             if stmt.where is None:
-                keep = jnp.zeros(0, dtype=jnp.int64)
+                keep_mask = jnp.zeros(table.plen, dtype=bool)
             else:
                 mask = planner._conjunct_mask(aliased,
                                               planner._split_conjuncts(stmt.where))
-                keep = jnp.nonzero(~mask)[0]
-            kept = table.take(keep)
+                keep_mask = ~mask
+            kept = E.compact_table(table, keep_mask)
             self.warehouse.overwrite(stmt.table, kept.to_arrow())
             self.catalog[stmt.table.lower()] = kept
             return Result(DeviceTable({}, 0))

@@ -1,5 +1,11 @@
 # Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
-"""DeviceTable: an ordered set of named device columns of equal length."""
+"""DeviceTable: an ordered set of named device columns of equal length.
+
+Padded-prefix invariant: columns may be physically longer than the table's
+logical row count (``nrows``); rows past ``nrows`` are garbage pads that
+every operator ignores (see :mod:`nds_tpu.engine.ops` — bucketed shapes).
+``plen`` is the physical length.
+"""
 
 from __future__ import annotations
 
@@ -7,11 +13,23 @@ from nds_tpu.engine.column import Column
 
 
 class DeviceTable:
-    def __init__(self, columns: dict[str, Column], nrows: int | None = None):
+    def __init__(self, columns: dict[str, Column], nrows: int | None = None,
+                 plen: int | None = None):
         self.columns = dict(columns)
         if nrows is None:
             nrows = len(next(iter(columns.values()))) if columns else 0
         self.nrows = nrows
+        # physical length; only meaningful to pass for column-less tables
+        # (aggregation contexts carry capacity without materialized columns)
+        if plen is None:
+            plen = len(next(iter(columns.values()))) if columns else nrows
+        self._plen = plen
+
+    @property
+    def plen(self) -> int:
+        if self.columns:
+            return len(next(iter(self.columns.values())))
+        return self._plen
 
     @property
     def column_names(self):
@@ -24,20 +42,25 @@ class DeviceTable:
         return name in self.columns
 
     def select(self, names) -> "DeviceTable":
-        return DeviceTable({n: self.columns[n] for n in names}, self.nrows)
+        return DeviceTable({n: self.columns[n] for n in names}, self.nrows,
+                           self.plen)
 
     def with_column(self, name: str, col: Column) -> "DeviceTable":
         cols = dict(self.columns)
         cols[name] = col
-        return DeviceTable(cols, self.nrows)
+        return DeviceTable(cols, self.nrows, self.plen)
 
     def rename(self, mapping: dict[str, str]) -> "DeviceTable":
         return DeviceTable(
-            {mapping.get(n, n): c for n, c in self.columns.items()}, self.nrows)
+            {mapping.get(n, n): c for n, c in self.columns.items()},
+            self.nrows, self.plen)
 
-    def take(self, indices) -> "DeviceTable":
+    def take(self, indices, nrows: int | None = None) -> "DeviceTable":
+        """Dense gather: logical length defaults to the index count (exact
+        materialization). Pass ``nrows`` when gathering with a padded index
+        vector or permutation to preserve the logical count."""
         cols = {n: c.take(indices) for n, c in self.columns.items()}
-        n = int(indices.shape[0])
+        n = int(indices.shape[0]) if nrows is None else nrows
         return DeviceTable(cols, n)
 
     def to_arrow(self):
@@ -51,4 +74,4 @@ class DeviceTable:
 
     def __repr__(self):
         cols = ", ".join(f"{n}:{c.kind}" for n, c in self.columns.items())
-        return f"DeviceTable[{self.nrows} rows]({cols})"
+        return f"DeviceTable[{self.nrows}/{self.plen} rows]({cols})"

@@ -37,23 +37,32 @@ class WindowContext:
     """One sort shared by every window function over the same
     (partition, order) spec."""
 
-    def __init__(self, partition_cols, order_cols=(), descending=None, nulls_last=None):
+    def __init__(self, partition_cols, order_cols=(), descending=None,
+                 nulls_last=None, n_valid: int | None = None):
         self.n = len(partition_cols[0]) if partition_cols else len(order_cols[0])
+        if n_valid is None:
+            n_valid = self.n
         all_cols = list(partition_cols) + list(order_cols)
         desc = [False] * len(partition_cols) + list(
             descending or [False] * len(order_cols))
         nl = [False] * len(partition_cols) + list(
             nulls_last or [d for d in (descending or [False] * len(order_cols))])
-        self.order = lexsort_indices(all_cols, desc, nl)
+        # pad rows sort last and are walled off into their own partition so
+        # no real partition's aggregate sees pad garbage
+        self.order = lexsort_indices(all_cols, desc, nl, n_valid=n_valid)
+        pos = jnp.arange(self.n)
         if self.n == 0:
             self.part_boundary = jnp.zeros(0, dtype=bool)
         elif partition_cols:
             self.part_boundary = _boundaries(partition_cols, self.order)
         else:
             self.part_boundary = jnp.zeros(self.n, dtype=bool).at[0].set(True)
+        if 0 < n_valid < self.n:
+            self.part_boundary = self.part_boundary | (pos == n_valid)
         self.gid_sorted = jnp.cumsum(self.part_boundary) - 1
-        self.ngroups = int(self.gid_sorted[-1]) + 1 if self.n else 0
-        pos = jnp.arange(self.n)
+        # segment capacity: physical length is a static upper bound on the
+        # partition count — no host sync, canonical shapes
+        self.ngroups = self.n
         # start position of each row's segment
         seg_starts = jnp.where(self.part_boundary, pos, 0)
         self.start_for_row = jax.ops.segment_max(
@@ -160,7 +169,9 @@ class WindowContext:
         """RANGE frames include order-key peers: every row takes the scan
         value of the LAST row of its peer run."""
         rid = jnp.cumsum(self.order_boundary) - 1
-        nruns = int(rid[-1]) + 1 if self.n else 0
+        # capacity bound, not exact count: avoids a host sync and keeps the
+        # segment-op shape canonical
+        nruns = self.n
         last_pos = jax.ops.segment_max(self.pos, rid, num_segments=nruns)
         return jnp.take(run, jnp.take(last_pos, rid))
 

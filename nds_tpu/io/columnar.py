@@ -33,8 +33,10 @@ def write_table(table: pa.Table, path: str, fmt: str = "parquet",
         import pyarrow.parquet as pq
         comp = compression or "snappy"
         if partition_col:
+            # a 5-year daily date_sk window exceeds pyarrow's default
+            # 1024-partition cap
             pq.write_to_dataset(table, root_path=path, partition_cols=[partition_col],
-                                compression=comp)
+                                compression=comp, max_partitions=1 << 16)
         else:
             pq.write_table(table, os.path.join(path, "part-0.parquet"), compression=comp)
     elif fmt == "orc":

@@ -67,8 +67,7 @@ class Planner:
             if q.order_by:
                 out = self._apply_order_by(out, q.order_by, q.body)
             if q.limit is not None:
-                out = DeviceTable(
-                    dict(E.limit_table(out, q.limit).columns), min(q.limit, out.nrows))
+                out = E.limit_table(out, q.limit)
             return out
         finally:
             self.cte_stack.pop()
@@ -102,8 +101,8 @@ class Planner:
             keys.append(col)
             desc.append(d)
             nl.append(last)
-        order = E.lexsort_indices(keys, desc, nl)
-        return out.take(order)
+        order = E.lexsort_indices(keys, desc, nl, n_valid=out.nrows)
+        return out.take(order, nrows=out.nrows)
 
     def set_expr(self, body) -> DeviceTable:
         if isinstance(body, A.Query):
@@ -137,15 +136,17 @@ class Planner:
             lkeys = [ldist[n] for n in ldist.column_names]
             rkeys = [right[n] for n in ldist.column_names]
             mask = E.semi_join_mask(lkeys, rkeys, negate=(body.op == "except"),
-                                    null_safe=True)
-            return ldist.take(jnp.nonzero(mask)[0])
+                                    null_safe=True, n_left=ldist.nrows,
+                                    n_right=right.nrows)
+            return E.compact_table(ldist, mask)
         raise ExecError(f"unsupported set expression {type(body).__name__}")
 
     def _distinct(self, t: DeviceTable) -> DeviceTable:
         if t.nrows == 0:
             return t
-        gids, ng, rep = E.group_ids([t[n] for n in t.column_names])
-        return t.take(rep)
+        gids, ng, rep, cap = E.group_ids([t[n] for n in t.column_names],
+                                         n_valid=t.nrows)
+        return t.take(rep, nrows=ng)
 
     # ------------------------------------------------------------------ FROM
 
@@ -307,18 +308,23 @@ class Planner:
             if residual:
                 # a left row matches only if some equi-matching right row also
                 # satisfies the residual conjuncts
-                l_idx, r_idx, _, _ = E.join_indices(lkeys, rkeys, "inner")
+                l_idx, r_idx, n_pairs, _, _, _, _ = E.join_indices(
+                    lkeys, rkeys, "inner",
+                    n_left=left.nrows, n_right=right.nrows)
                 pair_cols = {n: c.take(l_idx) for n, c in left.columns.items()}
                 pair_cols.update(
                     {n: c.take(r_idx) for n, c in right.columns.items()})
-                pairs = DeviceTable(pair_cols, int(l_idx.shape[0]))
+                pairs = DeviceTable(pair_cols, n_pairs)
                 ok = self._conjunct_mask(pairs, residual)
-                hit = jnp.take(l_idx, jnp.nonzero(ok)[0])
-                matched = jnp.zeros(left.nrows, dtype=bool).at[hit].set(True)
+                ok = ok & E.live_mask(pairs.plen, pairs.nrows)
+                safe = jnp.where(ok, l_idx, left.plen)
+                matched = jnp.zeros(left.plen, dtype=bool).at[safe].set(
+                    True, mode="drop")
             else:
-                matched = E.semi_join_mask(lkeys, rkeys)
+                matched = E.semi_join_mask(lkeys, rkeys, n_left=left.nrows,
+                                           n_right=right.nrows)
             mask = ~matched if kind == "anti" else matched
-            return left.take(jnp.nonzero(mask)[0])
+            return E.compact_table(left, mask)
         if not lkeys:
             # pure cartesian with optional residual filter
             out = self._cartesian(left, right)
@@ -333,33 +339,41 @@ class Planner:
             return E.join_tables(left, right, l_on, r_on, kind)
         # join with residual and/or expression keys: match pairs on the key
         # columns, filter by the residual conjuncts, then rebuild outer rows
-        l_idx, r_idx, _, _ = E.join_indices(lkeys, rkeys, "inner")
+        l_idx, r_idx, n_pairs, _, _, _, _ = E.join_indices(
+            lkeys, rkeys, "inner", n_left=left.nrows, n_right=right.nrows)
         pair_cols = {n: c.take(l_idx) for n, c in left.columns.items()}
         pair_cols.update({n: c.take(r_idx) for n, c in right.columns.items()})
-        pairs = DeviceTable(pair_cols, int(l_idx.shape[0]))
+        pairs = DeviceTable(pair_cols, n_pairs)
         keep_mask = self._conjunct_mask(pairs, residual)
-        keep = jnp.nonzero(keep_mask)[0]
-        l_idx, r_idx = jnp.take(l_idx, keep), jnp.take(r_idx, keep)
-        matched = pairs.take(keep)
+        keep_mask = keep_mask & E.live_mask(pairs.plen, pairs.nrows)
+        matched = E.compact_table(pairs, keep_mask)
         if kind == "inner":
             return matched
         out_parts = [matched]
         if kind in ("left", "full"):
-            lmask = jnp.zeros(left.nrows, dtype=bool).at[l_idx].set(True)
-            lx = jnp.nonzero(~lmask)[0]
-            if int(lx.shape[0]):
+            safe_l = jnp.where(keep_mask, l_idx, left.plen)
+            lmask = jnp.zeros(left.plen, dtype=bool).at[safe_l].set(
+                True, mode="drop")
+            miss = ~lmask & E.live_mask(left.plen, left.nrows)
+            n_lx = int(jnp.sum(miss))
+            if n_lx:
+                lx = E.compact_indices(miss, n_lx)
                 cols = {n: c.take(lx) for n, c in left.columns.items()}
                 cols.update({n: E._null_column_like(c, int(lx.shape[0]))
                              for n, c in right.columns.items()})
-                out_parts.append(DeviceTable(cols, int(lx.shape[0])))
+                out_parts.append(DeviceTable(cols, n_lx))
         if kind in ("right", "full"):
-            rmask = jnp.zeros(right.nrows, dtype=bool).at[r_idx].set(True)
-            rx = jnp.nonzero(~rmask)[0]
-            if int(rx.shape[0]):
+            safe_r = jnp.where(keep_mask, r_idx, right.plen)
+            rmask = jnp.zeros(right.plen, dtype=bool).at[safe_r].set(
+                True, mode="drop")
+            miss_r = ~rmask & E.live_mask(right.plen, right.nrows)
+            n_rx = int(jnp.sum(miss_r))
+            if n_rx:
+                rx = E.compact_indices(miss_r, n_rx)
                 cols = {n: E._null_column_like(c, int(rx.shape[0]))
                         for n, c in left.columns.items()}
                 cols.update({n: c.take(rx) for n, c in right.columns.items()})
-                out_parts.append(DeviceTable(cols, int(rx.shape[0])))
+                out_parts.append(DeviceTable(cols, n_rx))
         return E.concat_tables(out_parts) if len(out_parts) > 1 else out_parts[0]
 
     def _equi_pair(self, c, lcols, rcols):
@@ -443,16 +457,27 @@ class Planner:
         return None
 
     def _cartesian(self, left: DeviceTable, right: DeviceTable) -> DeviceTable:
+        pl, pr = left.plen, right.plen
         nl, nr = left.nrows, right.nrows
-        li = jnp.repeat(jnp.arange(nl), nr)
-        ri = jnp.tile(jnp.arange(nr), nl)
+        total = nl * nr
+        if pl == 0 or pr == 0 or total == 0:
+            cols = {n: E._null_column_like(c, E.bucket_len(0))
+                    for t in (left, right) for n, c in t.columns.items()}
+            return DeviceTable(cols, 0)
+        li = jnp.repeat(jnp.arange(pl), pr)
+        ri = jnp.tile(jnp.arange(pr), pl)
+        live = (li < nl) & (ri < nr)
+        # logical count is known on host: compact to bucket with no sync
+        idx = jnp.nonzero(live, size=E.bucket_len(total), fill_value=pl * pr)[0]
+        li = jnp.take(li, idx, mode="fill", fill_value=pl)
+        ri = jnp.take(ri, idx, mode="fill", fill_value=pr)
         cols = {n: c.take(li) for n, c in left.columns.items()}
         cols.update({n: c.take(ri) for n, c in right.columns.items()})
-        return DeviceTable(cols, nl * nr)
+        return DeviceTable(cols, total)
 
     def _conjunct_mask(self, table: DeviceTable, conjuncts) -> jnp.ndarray:
         ctx = EvalCtx(table)
-        mask = jnp.ones(table.nrows, dtype=bool)
+        mask = jnp.ones(table.plen, dtype=bool)
         for c in conjuncts:
             col = self.eval_expr(c, ctx)
             mask = mask & col.data.astype(bool) & col.valid_mask()
@@ -461,7 +486,7 @@ class Planner:
     def _filter_conjuncts(self, table: DeviceTable, conjuncts) -> DeviceTable:
         if not conjuncts:
             return table
-        return table.take(jnp.nonzero(self._conjunct_mask(table, conjuncts))[0])
+        return E.compact_table(table, self._conjunct_mask(table, conjuncts))
 
     def _join_parts(self, parts, join_preds, where_conjuncts):
         """Join-graph execution: push single-table predicates down, then join
@@ -602,11 +627,11 @@ class Planner:
             if name in cols:
                 name = f"{name}_{i}"
             col = self.eval_expr(item.expr, ctx)
-            if len(col) != ctx.table.nrows:
+            if len(col) != ctx.table.plen:
                 raise ExecError(f"projection arity mismatch for {name}")
             cols[name] = col
             ctx.select_aliases[name] = col
-        return DeviceTable(cols, ctx.table.nrows)
+        return DeviceTable(cols, ctx.table.nrows, plen=ctx.table.plen)
 
     # ------------------------------------------------------------ aggregation
 
@@ -658,38 +683,43 @@ class Planner:
                 if active or group_by.kind != "plain" or group_exprs:
                     continue
             if active:
-                gids, ng, rep = E.group_ids(active)
+                gids, ng, rep, cap = E.group_ids(active, n_valid=table.nrows)
             else:
-                gids = jnp.zeros(table.nrows, dtype=jnp.int64)
-                ng, rep = 1, jnp.zeros(1, dtype=jnp.int64)
-            post = EvalCtx(DeviceTable({}, ng), post_agg=True)
+                # global aggregate: live rows in group 0, pads in a dropped
+                # trailing slot
+                ng, cap = 1, E.bucket_len(1)
+                gids = jnp.where(E.live_mask(table.plen, table.nrows),
+                                 0, cap).astype(jnp.int64)
+                rep = jnp.zeros(cap, dtype=jnp.int64)
+            post = EvalCtx(DeviceTable({}, ng, plen=cap), post_agg=True)
             # group key columns (taken at representatives); inactive keys null
             for i, (kname, kcol) in enumerate(zip(key_names, key_cols)):
                 if kname in gset_keys:
                     post.group_values[kname] = kcol.take(rep) if table.nrows else \
-                        X.literal(None, ng)
+                        X.literal(None, cap)
                     post.grouping_flags[kname] = 0
                 else:
-                    null = X.literal(None, ng)
+                    null = X.literal(None, cap)
                     if kcol.kind == "str":
-                        null = Column("str", jnp.zeros(ng, dtype=jnp.int32),
-                                      jnp.zeros(ng, dtype=bool), kcol.dict_values)
+                        null = Column("str", jnp.zeros(cap, dtype=jnp.int32),
+                                      jnp.zeros(cap, dtype=bool), kcol.dict_values)
                     else:
                         null = Column(kcol.kind,
-                                      jnp.zeros(ng, dtype=kcol.data.dtype),
-                                      jnp.zeros(ng, dtype=bool), kcol.dict_values)
+                                      jnp.zeros(cap, dtype=kcol.data.dtype),
+                                      jnp.zeros(cap, dtype=bool), kcol.dict_values)
                     post.group_values[kname] = null
                     post.grouping_flags[kname] = 1
-            # aggregates
+            # aggregates (segment capacity = cap keeps shapes canonical; pad
+            # contributions land past ng or are dropped)
             for akey, call in agg_calls.items():
-                post.agg_values[akey] = self._compute_agg(call, base_ctx, gids, ng,
-                                                          active)
-            post.table = DeviceTable({}, ng)
+                post.agg_values[akey] = self._compute_agg(call, base_ctx, gids,
+                                                          cap, active)
+            post.table = DeviceTable({}, ng, plen=cap)
             # HAVING before projection
             if sel.having is not None:
                 mask_col = self.eval_expr(sel.having, post)
-                keep = jnp.nonzero(mask_col.data.astype(bool) & mask_col.valid_mask())[0]
-                post = self._take_ctx(post, keep)
+                post = self._mask_ctx(
+                    post, mask_col.data.astype(bool) & mask_col.valid_mask())
             self._eval_windows(sel, post)
             out = self._project(sel, post)
             set_tables.append((out, post))
@@ -710,10 +740,14 @@ class Planner:
         tables = [t for t, _ in set_tables]
         return E.concat_tables(tables), set_tables[0][1]
 
-    def _take_ctx(self, ctx: EvalCtx, idx) -> EvalCtx:
+    def _mask_ctx(self, ctx: EvalCtx, mask) -> EvalCtx:
+        """Compact an aggregation context by a boolean mask (HAVING)."""
+        m = mask & E.live_mask(ctx.table.plen, ctx.table.nrows)
+        n = int(jnp.sum(m))
+        idx = E.compact_indices(m, n)
         new = EvalCtx(DeviceTable(
-            {n: c.take(idx) for n, c in ctx.table.columns.items()}, int(idx.shape[0])),
-            post_agg=True)
+            {nm: c.take(idx) for nm, c in ctx.table.columns.items()}, n,
+            plen=int(idx.shape[0])), post_agg=True)
         new.group_values = {k: c.take(idx) for k, c in ctx.group_values.items()}
         new.agg_values = {k: c.take(idx) for k, c in ctx.agg_values.items()}
         new.grouping_flags = dict(ctx.grouping_flags)
@@ -722,14 +756,15 @@ class Planner:
 
     def _compute_agg(self, call: A.FuncCall, base_ctx: EvalCtx, gids, ng, key_cols):
         name = call.name
+        n_base = base_ctx.table.nrows
         if name == "count" and call.star:
             return E.agg_count(None, gids, ng)
         arg = self.eval_expr(call.args[0], base_ctx) if call.args else None
         if call.distinct:
             if name == "count":
-                return self._count_distinct(arg, gids, ng, key_cols)
+                return self._count_distinct(arg, gids, ng, n_base)
             if name in ("sum", "avg"):
-                return self._sum_avg_distinct(name, arg, gids, ng, key_cols)
+                return self._sum_avg_distinct(name, arg, gids, ng, n_base)
             # min/max distinct == plain
         if name == "count":
             return E.agg_count(arg, gids, ng)
@@ -747,28 +782,32 @@ class Planner:
             sd = E.agg_stddev_samp(arg, gids, ng)
             return Column("f64", sd.data * sd.data, sd.valid)
         if name == "approx_count_distinct":
-            return self._count_distinct(arg, gids, ng, key_cols)
+            return self._count_distinct(arg, gids, ng, n_base)
         raise ExecError(f"unsupported aggregate {name}")
 
-    def _count_distinct(self, arg: Column, gids, ng, key_cols):
+    def _count_distinct(self, arg: Column, gids, ng, n_base: int):
         if len(arg) == 0:
             return Column("i64", jnp.zeros(ng, dtype=jnp.int64))
         gid_col = Column("i64", gids)
-        inner_gids, inner_ng, inner_rep = E.group_ids([gid_col, arg])
-        outer_at_rep = jnp.take(gids, inner_rep)
-        valid_at_rep = jnp.take(arg.valid_mask(), inner_rep).astype(jnp.int64)
+        inner_gids, inner_ng, inner_rep, inner_cap = E.group_ids(
+            [gid_col, arg], n_valid=n_base)
+        # inner_rep pad slots are out of range: route them to the dropped
+        # segment instead of letting a clipped gather pollute a real group
+        outer_at_rep = jnp.take(gids, inner_rep, mode="fill", fill_value=ng)
+        valid_at_rep = jnp.take(arg.valid_mask(), inner_rep, mode="fill",
+                                fill_value=False).astype(jnp.int64)
         import jax
         out = jax.ops.segment_sum(valid_at_rep, outer_at_rep, num_segments=ng)
         return Column("i64", out)
 
-    def _sum_avg_distinct(self, name, arg: Column, gids, ng, key_cols):
-        import jax
+    def _sum_avg_distinct(self, name, arg: Column, gids, ng, n_base: int):
         if len(arg) == 0:
             return Column("f64" if name == "avg" else arg.kind,
                           jnp.zeros(ng, dtype=jnp.float64 if name == "avg" else jnp.int64))
         gid_col = Column("i64", gids)
-        inner_gids, inner_ng, inner_rep = E.group_ids([gid_col, arg])
-        outer_at_rep = jnp.take(gids, inner_rep)
+        inner_gids, inner_ng, inner_rep, inner_cap = E.group_ids(
+            [gid_col, arg], n_valid=n_base)
+        outer_at_rep = jnp.take(gids, inner_rep, mode="fill", fill_value=ng)
         rep_arg = arg.take(inner_rep)
         if name == "sum":
             return E.agg_sum(rep_arg, outer_at_rep, ng)
@@ -812,7 +851,8 @@ class Planner:
                 ocols = [self.eval_expr(e, ctx) for e, _, _ in w.spec.order_by]
                 desc = [d for _, d, _ in w.spec.order_by]
                 nl = [n for _, _, n in w.spec.order_by]
-                contexts[skey] = WindowContext(pcols, ocols, desc, nl)
+                contexts[skey] = WindowContext(pcols, ocols, desc, nl,
+                                               n_valid=ctx.table.nrows)
             wc = contexts[skey]
             fname = w.func.name
             if fname == "row_number":
@@ -823,7 +863,7 @@ class Planner:
                 col = wc.dense_rank()
             elif fname in ("sum", "avg", "min", "max", "count"):
                 arg = (self.eval_expr(w.func.args[0], ctx) if w.func.args
-                       else Column("i64", jnp.ones(ctx.table.nrows, dtype=jnp.int64)))
+                       else Column("i64", jnp.ones(ctx.table.plen, dtype=jnp.int64)))
                 frame = w.spec.frame
                 if frame is None and w.spec.order_by:
                     # SQL default with ORDER BY: RANGE UNBOUNDED PRECEDING ..
@@ -841,7 +881,7 @@ class Planner:
     # ----------------------------------------------------------- expressions
 
     def eval_expr(self, e, ctx: EvalCtx) -> Column:
-        n = ctx.table.nrows
+        n = ctx.table.plen     # new columns are built at physical length
         k = expr_key(e)
         if ctx.window_values and k in ctx.window_values:
             return ctx.window_values[k]
@@ -1041,7 +1081,7 @@ class Planner:
         return X.logical_not(res) if e.negated else res
 
     def _eval_case(self, e: A.Case, ctx: EvalCtx) -> Column:
-        n = ctx.table.nrows
+        n = ctx.table.plen
         branches = []
         if e.operand is not None:
             op = self.eval_expr(e.operand, ctx)
@@ -1058,7 +1098,7 @@ class Planner:
 
     def _eval_func(self, e: A.FuncCall, ctx: EvalCtx) -> Column:
         name = e.name
-        n = ctx.table.nrows
+        n = ctx.table.plen
         if name == "grouping":
             flag = self._lookup_grouping_flag(e.args[0], ctx)
             return Column("i64", jnp.full(n, flag, dtype=jnp.int64))
@@ -1222,7 +1262,7 @@ class Planner:
         return corr, stripped, residual
 
     def _eval_exists(self, e: A.Exists, ctx: EvalCtx) -> Column:
-        n = ctx.table.nrows
+        n = ctx.table.plen
         found = self._find_correlation(e.query, ctx)
         if found is None:
             t = self.query(e.query)
@@ -1244,15 +1284,18 @@ class Planner:
             lkeys = [self.eval_expr(outer, ctx) for outer, _ in corr]
             rkeys = [self.eval_expr(inner, EvalCtx(inner_t))
                      for _, inner in corr]
-            l_idx, r_idx, _, _ = E.join_indices(lkeys, rkeys, "inner")
+            l_idx, r_idx, n_pairs, _, _, _, _ = E.join_indices(
+                lkeys, rkeys, "inner",
+                n_left=ctx.table.nrows, n_right=inner_t.nrows)
             pair_cols = {nm: c.take(r_idx)
                          for nm, c in inner_t.columns.items()}
             for nm, c in ctx.table.columns.items():
                 pair_cols.setdefault(nm, c.take(l_idx))
-            pairs = DeviceTable(pair_cols, int(l_idx.shape[0]))
+            pairs = DeviceTable(pair_cols, n_pairs)
             ok = self._conjunct_mask(pairs, residual)
-            hit = jnp.take(l_idx, jnp.nonzero(ok)[0])
-            matched = jnp.zeros(n, dtype=bool).at[hit].set(True)
+            ok = ok & E.live_mask(pairs.plen, pairs.nrows)
+            safe = jnp.where(ok, l_idx, n)
+            matched = jnp.zeros(n, dtype=bool).at[safe].set(True, mode="drop")
             return Column("bool", ~matched if e.negated else matched)
         inner_items = [A.SelectItem(inner, f"_ck{i}")
                        for i, (_, inner) in enumerate(corr)]
@@ -1261,7 +1304,8 @@ class Planner:
         rt = self.query(sub)
         lkeys = [self.eval_expr(outer, ctx) for outer, _ in corr]
         rkeys = [rt[c] for c in rt.column_names]
-        mask = E.semi_join_mask(lkeys, rkeys, negate=e.negated)
+        mask = E.semi_join_mask(lkeys, rkeys, negate=e.negated,
+                                n_left=ctx.table.nrows, n_right=rt.nrows)
         return Column("bool", mask)
 
     def _eval_in_subquery(self, e: A.InSubquery, ctx: EvalCtx) -> Column:
@@ -1271,11 +1315,12 @@ class Planner:
             rcol = rt[rt.column_names[0]]
             lcol = self.eval_expr(e.expr, ctx)
             lcol2, rcol2 = self._coerce_pair(lcol, rcol)
-            mask = E.semi_join_mask([lcol2], [rcol2], negate=e.negated)
+            mask = E.semi_join_mask([lcol2], [rcol2], negate=e.negated,
+                                    n_left=ctx.table.nrows, n_right=rt.nrows)
             if e.negated:
                 # ANSI NOT IN: any NULL on the right makes the predicate
                 # NULL (never true); a NULL lhs is NULL too
-                if rcol2.null_count() > 0:
+                if rcol2.null_count(rt.nrows) > 0:
                     return Column("bool", jnp.zeros(len(lcol2), dtype=bool))
                 return Column("bool", mask & lcol2.valid_mask())
             return Column("bool", mask)
@@ -1295,22 +1340,27 @@ class Planner:
         for lc, rc in zip(lcols, rcols):
             lc2, _ = self._coerce_pair(lc, rc)
             lcols2.append(lc2)
-        mask = E.semi_join_mask(lcols2, rcols)
+        mask = E.semi_join_mask(lcols2, rcols, n_left=ctx.table.nrows,
+                                n_right=rt.nrows)
         if not e.negated:
             return Column("bool", mask)
         # ANSI NOT IN per correlation group: a NULL lhs, or any NULL value in
         # the row's matching group, makes the predicate NULL (never true)
-        keep = ~mask & lcols2[0].valid_mask()
+        keep = ~mask & lcols2[0].valid_mask() & \
+            E.live_mask(ctx.table.plen, ctx.table.nrows)
         val_col = rcols[0]
-        if val_col.null_count() > 0:
-            null_rows = jnp.nonzero(~val_col.valid_mask())[0]
+        n_nulls = val_col.null_count(rt.nrows)
+        if n_nulls > 0:
+            nullm = ~val_col.valid_mask() & E.live_mask(rt.plen, rt.nrows)
+            null_rows = E.compact_indices(nullm, n_nulls)
             null_keys = [c.take(null_rows) for c in rcols[1:]]
-            group_has_null = E.semi_join_mask(lcols2[1:], null_keys)
+            group_has_null = E.semi_join_mask(
+                lcols2[1:], null_keys, n_left=ctx.table.nrows, n_right=n_nulls)
             keep = keep & ~group_has_null
         return Column("bool", keep)
 
     def _eval_scalar_subquery(self, e: A.ScalarSubquery, ctx: EvalCtx) -> Column:
-        n = ctx.table.nrows
+        n = ctx.table.plen
         found = self._find_correlation(e.query, ctx)
         if found is None:
             rt = self.query(e.query)
@@ -1341,21 +1391,24 @@ class Planner:
         rkeys = [rt[c] for c in rt.column_names[1:1 + len(corr)]]
         lkeys = [self.eval_expr(outer, ctx) for outer, _ in corr]
         lkeys = [self._coerce_pair(lc, rc)[0] for lc, rc in zip(lkeys, rkeys)]
-        l_idx, r_idx, _, _ = E.join_indices(lkeys, rkeys, "inner")
+        l_idx, r_idx, n_pairs, _, _, _, _ = E.join_indices(
+            lkeys, rkeys, "inner", n_left=ctx.table.nrows, n_right=rt.nrows)
         # the subquery was grouped by its correlation keys, so each outer row
         # may match at most once; more than one match means the original
         # subquery was not scalar per outer row
-        if int(l_idx.shape[0]) != int(jnp.unique(l_idx).shape[0]):
+        hits = jnp.zeros(n, dtype=jnp.int32).at[l_idx].add(1, mode="drop")
+        if n_pairs and int(jnp.max(hits)) > 1:
             raise ExecError("correlated scalar subquery returned more than one "
                             "row per outer row")
         data = jnp.zeros(n, dtype=val_col.data.dtype)
         valid = jnp.zeros(n, dtype=bool)
-        data = data.at[l_idx].set(jnp.take(val_col.data, r_idx))
-        valid = valid.at[l_idx].set(jnp.take(val_col.valid_mask(), r_idx))
+        data = data.at[l_idx].set(jnp.take(val_col.data, r_idx), mode="drop")
+        valid = valid.at[l_idx].set(jnp.take(val_col.valid_mask(), r_idx),
+                                    mode="drop")
         return Column(val_col.kind, data, valid, val_col.dict_values)
 
     def _eval_quantified(self, e: A.QuantifiedCompare, ctx: EvalCtx) -> Column:
-        n = ctx.table.nrows
+        n = ctx.table.plen
         if e.op == "=" and e.quantifier == "any":
             return self._eval_in_subquery(A.InSubquery(e.expr, e.query, False), ctx)
         if e.op == "<>" and e.quantifier == "all":
@@ -1366,7 +1419,8 @@ class Planner:
         if rt.nrows == 0:
             val = e.quantifier == "all"
             return Column("bool", jnp.full(n, val, dtype=bool))
-        gids = jnp.zeros(rt.nrows, dtype=jnp.int64)
+        # live rows reduce into segment 0; pads go to the dropped segment
+        gids = jnp.where(E.live_mask(rt.plen, rt.nrows), 0, 1).astype(jnp.int64)
 
         def broadcast(red):
             return Column(red.kind, jnp.broadcast_to(red.data[0], (n,)),

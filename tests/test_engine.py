@@ -53,7 +53,7 @@ def test_arrow_roundtrip():
 def test_filter_matches_pandas():
     arrow, df = make_table()
     dt = dev(arrow)
-    pred = X.compare("<", dt["v"], X.literal(10, dt.nrows))
+    pred = X.compare("<", dt["v"], X.literal(10, dt.plen))
     out = E.filter_table(dt, pred)
     expected = df[df["v"] < 10]
     assert out.nrows == len(expected)
@@ -64,22 +64,22 @@ def test_filter_matches_pandas():
 def test_group_agg_matches_pandas():
     arrow, df = make_table()
     dt = dev(arrow)
-    gids, ng, rep = E.group_ids([dt["k"]])
-    s = E.agg_sum(dt["v"], gids, ng)
-    c = E.agg_count(None, gids, ng)
-    cnn = E.agg_count(dt["v"], gids, ng)
-    mn = E.agg_min(dt["v"], gids, ng)
-    mx = E.agg_min(dt["v"], gids, ng, is_max=True)
-    av = E.agg_avg(dt["v"], gids, ng)
+    gids, ng, rep, cap = E.group_ids([dt["k"]], n_valid=dt.nrows)
+    s = E.agg_sum(dt["v"], gids, cap)
+    c = E.agg_count(None, gids, cap)
+    cnn = E.agg_count(dt["v"], gids, cap)
+    mn = E.agg_min(dt["v"], gids, cap)
+    mx = E.agg_min(dt["v"], gids, cap, is_max=True)
+    av = E.agg_avg(dt["v"], gids, cap)
     keys = dt["k"].take(rep)
     got = pd.DataFrame({
-        "k": np.asarray(keys.data),
-        "sum": np.asarray(s.data),
-        "cnt": np.asarray(c.data),
-        "cntv": np.asarray(cnn.data),
-        "min": np.asarray(mn.data),
-        "max": np.asarray(mx.data),
-        "avg": np.asarray(av.data),
+        "k": np.asarray(keys.data)[:ng],
+        "sum": np.asarray(s.data)[:ng],
+        "cnt": np.asarray(c.data)[:ng],
+        "cntv": np.asarray(cnn.data)[:ng],
+        "min": np.asarray(mn.data)[:ng],
+        "max": np.asarray(mx.data)[:ng],
+        "avg": np.asarray(av.data)[:ng],
     }).sort_values("k").reset_index(drop=True)
     exp = df.groupby("k").agg(
         sum=("v", lambda x: x.sum()),
@@ -101,8 +101,8 @@ def test_group_agg_matches_pandas():
 def test_group_by_string_with_nulls():
     arrow, df = make_table()
     dt = dev(arrow)
-    gids, ng, rep = E.group_ids([dt["s"]])
-    c = E.agg_count(None, gids, ng)
+    gids, ng, rep, cap = E.group_ids([dt["s"]], n_valid=dt.nrows)
+    c = E.agg_count(None, gids, cap)
     keys = dt["s"].take(rep)
     got = {}
     kcol = keys
@@ -148,10 +148,12 @@ def test_semi_anti_join():
     left = pa.table({"a": pa.array([1, 2, 3, None], pa.int64())})
     right = pa.table({"b": pa.array([2, 3], pa.int64())})
     lt, rt = dev(left), dev(right)
-    semi = np.asarray(E.semi_join_mask([lt["a"]], [rt["b"]]))
-    anti = np.asarray(E.semi_join_mask([lt["a"]], [rt["b"]], negate=True))
-    assert semi.tolist() == [False, True, True, False]
-    assert anti.tolist() == [True, False, False, True]
+    semi = np.asarray(E.semi_join_mask([lt["a"]], [rt["b"]],
+                                       n_left=lt.nrows, n_right=rt.nrows))
+    anti = np.asarray(E.semi_join_mask([lt["a"]], [rt["b"]], negate=True,
+                                       n_left=lt.nrows, n_right=rt.nrows))
+    assert semi.tolist()[:4] == [False, True, True, False]
+    assert anti.tolist()[:4] == [True, False, False, True]
 
 
 def test_sort_with_nulls_and_desc():
@@ -178,27 +180,29 @@ def test_string_sort():
 def test_decimal_arith_exact():
     arrow, df = make_table()
     dt = dev(arrow)
-    qty = X.literal(3, dt.nrows)
+    qty = X.literal(3, dt.plen)
     ext = X.arith("*", dt["price"], qty)
     assert ext.kind == "dec(38,2)"
-    got = np.asarray(ext.data)
+    got = np.asarray(ext.data)[:dt.nrows]
     exp = np.round(df["price"].astype(float) * 3 * 100).astype(np.int64)
     np.testing.assert_array_equal(got, exp)
     total = X.arith("+", ext, dt["price"])
-    got2 = np.asarray(total.data)
-    np.testing.assert_array_equal(got2, exp + np.asarray(dt["price"].data))
+    got2 = np.asarray(total.data)[:dt.nrows]
+    np.testing.assert_array_equal(
+        got2, exp + np.asarray(dt["price"].data)[:dt.nrows])
 
 
 def test_case_when_and_coalesce():
     arrow, df = make_table()
     dt = dev(arrow)
-    cond = X.compare(">", dt["v"], X.literal(0, dt.nrows))
-    res = X.case_when([(cond, X.literal(1, dt.nrows))], X.literal(0, dt.nrows))
-    got = np.asarray(res.data)
+    cond = X.compare(">", dt["v"], X.literal(0, dt.plen))
+    res = X.case_when([(cond, X.literal(1, dt.plen))], X.literal(0, dt.plen))
+    got = np.asarray(res.data)[:dt.nrows]
     exp = (df["v"] > 0).astype(int).values
     np.testing.assert_array_equal(got, exp)
-    co = X.coalesce([dt["v"], X.literal(-999, dt.nrows)])
-    got = np.asarray(co.data)[np.asarray(~dt["v"].valid_mask())]
+    co = X.coalesce([dt["v"], X.literal(-999, dt.plen)])
+    nulls = np.asarray(~dt["v"].valid_mask())[:dt.nrows]
+    got = np.asarray(co.data)[:dt.nrows][nulls]
     assert (got == -999).all()
 
 
@@ -206,13 +210,13 @@ def test_like_and_substr():
     arrow, df = make_table()
     dt = dev(arrow)
     lk = X.fn_like(dt["s"], "%pp%")
-    got = out = np.asarray(lk.data) & np.asarray(lk.valid_mask())
+    got = (np.asarray(lk.data) & np.asarray(lk.valid_mask()))[:dt.nrows]
     exp = df["s"].str.contains("pp", na=False).values
     np.testing.assert_array_equal(got, exp)
     sub = X.fn_substr(dt["s"], 1, 2)
-    vals = sub.dict_values[np.asarray(sub.data)]
+    vals = sub.dict_values[np.asarray(sub.data)][:dt.nrows]
     exp2 = df["s"].str[:2]
-    valid = np.asarray(sub.valid_mask())
+    valid = np.asarray(sub.valid_mask())[:dt.nrows]
     for g, e, ok in zip(vals, exp2, valid):
         if ok:
             assert g == e
@@ -221,11 +225,13 @@ def test_like_and_substr():
 def test_window_rank_rownumber():
     arrow, df = make_table(500)
     dt = dev(arrow)
-    ctx = WindowContext([dt["k"]], [dt["f"]], descending=[True])
+    ctx = WindowContext([dt["k"]], [dt["f"]], descending=[True],
+                        n_valid=dt.nrows)
     rn = ctx.row_number()
     rk = ctx.rank()
     got = pd.DataFrame({"k": df["k"], "f": df["f"],
-                        "rn": np.asarray(rn.data), "rk": np.asarray(rk.data)})
+                        "rn": np.asarray(rn.data)[:dt.nrows],
+                        "rk": np.asarray(rk.data)[:dt.nrows]})
     exp_rn = df.groupby("k")["f"].rank(method="first", ascending=False).astype(int)
     exp_rk = df.groupby("k")["f"].rank(method="min", ascending=False).astype(int)
     np.testing.assert_array_equal(got["rn"].values, exp_rn.values)
@@ -235,18 +241,20 @@ def test_window_rank_rownumber():
 def test_window_partition_sum_avg():
     arrow, df = make_table(500)
     dt = dev(arrow)
-    ctx = WindowContext([dt["k"]])
+    ctx = WindowContext([dt["k"]], n_valid=dt.nrows)
     s = ctx.partition_agg(dt["v"], "sum")
     a = ctx.partition_agg(dt["v"], "avg")
     exp_s = df.groupby("k")["v"].transform("sum")
     exp_a = df.groupby("k")["v"].transform("mean")
-    np.testing.assert_array_equal(np.asarray(s.data), exp_s.values.astype(np.int64))
-    np.testing.assert_allclose(np.asarray(a.data), exp_a.values, rtol=1e-12)
+    np.testing.assert_array_equal(np.asarray(s.data)[:dt.nrows],
+                                  exp_s.values.astype(np.int64))
+    np.testing.assert_allclose(np.asarray(a.data)[:dt.nrows], exp_a.values,
+                               rtol=1e-12)
 
 
 def test_union_all_dict_merge():
     t1 = dev(pa.table({"s": pa.array(["a", "b", "a"])}))
     t2 = dev(pa.table({"s": pa.array(["c", "b"])}))
     out = E.concat_tables([t1, t2])
-    vals = out["s"].dict_values[np.asarray(out["s"].data)]
+    vals = out["s"].dict_values[np.asarray(out["s"].data)][:out.nrows]
     assert list(vals) == ["a", "b", "a", "c", "b"]
