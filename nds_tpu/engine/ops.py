@@ -22,6 +22,8 @@ compile-once-run-many analog of the reference's warmed JVM+plugin
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -81,13 +83,40 @@ def take_padded(table: DeviceTable, idx: jnp.ndarray, nrows: int) -> DeviceTable
 # ---------------------------------------------------------------------------
 
 
+def _identity_cache(cache: dict, max_size: int, key_arrays: tuple, compute):
+    """Bounded FIFO cache keyed by the identity of host arrays. The entry
+    holds references to the keyed arrays so a recycled id() can never alias
+    a freed object; evicts oldest-first past ``max_size``."""
+    key = tuple(id(a) for a in key_arrays)
+    hit = cache.get(key)
+    if hit is not None and all(h is a for h, a in zip(hit[0], key_arrays)):
+        return hit[1]
+    value = compute()
+    if len(cache) >= max_size:
+        cache.pop(next(iter(cache)))
+    cache[key] = (key_arrays, value)
+    return value
+
+
+_rank_cache: dict = {}
+
+
+def _dict_ranks(dict_values) -> tuple:
+    """(code -> lexicographic rank, rank -> code) device maps for one string
+    dictionary, cached per dictionary (sorts repeat the same dictionaries
+    every query)."""
+    def compute():
+        order = np.argsort(dict_values.astype(str), kind="stable")
+        ranks = np.empty(len(order), dtype=np.int64)
+        ranks[order] = np.arange(len(order))
+        return jnp.asarray(ranks), jnp.asarray(order.astype(np.int64))
+    return _identity_cache(_rank_cache, 512, (dict_values,), compute)
+
+
 def ordered_codes(col: Column) -> jnp.ndarray:
     """For a string column, map dictionary codes to lexicographic ranks so
     integer comparisons order like string comparisons."""
-    order = np.argsort(col.dict_values.astype(str), kind="stable")
-    ranks = np.empty(len(order), dtype=np.int64)
-    ranks[order] = np.arange(len(order))
-    return jnp.take(jnp.asarray(ranks), col.data)
+    return jnp.take(_dict_ranks(col.dict_values)[0], col.data)
 
 
 def sortable_view(col: Column) -> jnp.ndarray:
@@ -97,6 +126,35 @@ def sortable_view(col: Column) -> jnp.ndarray:
     if col.kind == "bool":
         return col.data.astype(jnp.int32)
     return col.data
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _lexsort_impl(views, valids, descending, nulls_last, pad_key, n_valid):
+    """Jit-fused key assembly + variadic sort. ``views`` are numeric
+    sortable views (host-side string ranking already applied); ``valids``
+    is a tuple of masks-or-None (structure is static); flag tuples are
+    static. One XLA program per (arity, null pattern, flags, bucket)."""
+    n = views[0].shape[0]
+    keys = []  # build primary-first, then reverse for lexsort
+    if pad_key:
+        keys.append(jnp.arange(n) >= n_valid)   # False (live) first
+    for v, valid, desc, nl in zip(views, valids, descending, nulls_last):
+        if v.dtype != jnp.float64:
+            v = v.astype(jnp.int64)
+        if desc:
+            v = -v
+        null_rank_when_null = 1 if nl else -1
+        if valid is not None:
+            nullk = jnp.where(valid, 0, null_rank_when_null)
+            # zero the value under nulls so the value tiebreak is stable
+            v = jnp.where(valid, v, jnp.zeros((), dtype=v.dtype))
+            # null flag outranks the value within each sort key
+            keys.append(nullk)
+        # (a column with no null mask needs no flag key — each flag key is a
+        # whole extra stable-sort pass inside lexsort)
+        keys.append(v)
+    # jnp.lexsort: last key is primary => reverse (primary-first -> last)
+    return jnp.lexsort(tuple(reversed(keys)))
 
 
 def lexsort_indices(cols, descending=None, nulls_last=None,
@@ -110,25 +168,11 @@ def lexsort_indices(cols, descending=None, nulls_last=None,
         descending = [False] * len(cols)
     if nulls_last is None:
         nulls_last = [False] * len(cols)
-    keys = []  # build primary-first, then reverse for lexsort
-    if n_valid is not None and n_valid < n:
-        keys.append(~live_mask(n, n_valid))   # False (live) first
-    for col, desc, nl in zip(cols, descending, nulls_last):
-        v = sortable_view(col).astype(jnp.int64) if col.kind != "f64" else sortable_view(col)
-        if desc:
-            v = -v
-        null_rank_when_null = 1 if nl else -1
-        if col.valid is not None:
-            nullk = jnp.where(col.valid, 0, null_rank_when_null)
-            # zero the value under nulls so the value tiebreak is stable
-            v = jnp.where(col.valid, v, jnp.zeros((), dtype=v.dtype))
-            # null flag outranks the value within each sort key
-            keys.append(nullk)
-        # (a column with no null mask needs no flag key — each flag key is a
-        # whole extra stable-sort pass inside lexsort)
-        keys.append(v)
-    # jnp.lexsort: last key is primary => reverse (primary-first -> last)
-    return jnp.lexsort(tuple(reversed(keys)))
+    pad_key = n_valid is not None and n_valid < n
+    views = tuple(sortable_view(c) for c in cols)
+    valids = tuple(c.valid for c in cols)
+    return _lexsort_impl(views, valids, tuple(descending), tuple(nulls_last),
+                         pad_key, 0 if n_valid is None else n_valid)
 
 
 # ---------------------------------------------------------------------------
@@ -148,6 +192,47 @@ def _dense_codes(v: jnp.ndarray) -> jnp.ndarray:
 
 
 _PAD_GROUP_KEY = jnp.iinfo(jnp.int64).max // 2
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _group_rep_impl(gids, n_valid, cap):
+    """First-occurrence row index of each live group, bucket-padded to
+    ``cap`` (static); the pad group scatters out of range and is dropped."""
+    plen = gids.shape[0]
+    live = jnp.arange(plen) < n_valid
+    scatter_ids = jnp.where(live, gids, cap)
+    return jnp.full(cap, plen, dtype=jnp.int64).at[scatter_ids].min(
+        jnp.arange(plen, dtype=jnp.int64), mode="drop")
+
+
+@jax.jit
+def _group_ids_impl(views, valids, n_valid):
+    """Jit-fused iterative dense re-coding (see :func:`group_ids`). One XLA
+    program per (arity, null pattern, bucket); returns per-row dense group
+    ids with pads in one trailing group, plus the live group count as a
+    device scalar (the caller's single host sync)."""
+    plen = views[0].shape[0]
+    live = jnp.arange(plen) < n_valid
+    fold = jnp.int64(2 * plen + 2)
+    combined = None
+    for v, valid in zip(views, valids):
+        if valid is not None:
+            # zero data under nulls: all-null rows must compare equal
+            v = jnp.where(valid, v, jnp.zeros((), dtype=v.dtype))
+        codes = _dense_codes(v)
+        if valid is not None:
+            codes = 2 * codes + (~valid).astype(jnp.int64)
+        if combined is None:
+            combined = codes
+        else:
+            # fold and immediately re-densify: both operands stay < 2*plen+2,
+            # so the product below never overflows int64
+            combined = _dense_codes(combined) * fold + codes
+    # pad rows form one trailing group (the sort key exceeds any real code)
+    combined = jnp.where(live, combined, _PAD_GROUP_KEY)
+    gids = _dense_codes(combined)
+    ngroups = jnp.max(jnp.where(live, gids, -1)) + 1
+    return gids, ngroups
 
 
 def group_ids(key_cols, n_valid: int | None = None):
@@ -177,32 +262,12 @@ def group_ids(key_cols, n_valid: int | None = None):
         cap = bucket_len(0)
         return (jnp.zeros(0, dtype=jnp.int64), 0,
                 jnp.full(cap, 1, dtype=jnp.int64), cap)
-    live = live_mask(plen, n_valid)
-    fold = jnp.int64(2 * plen + 2)
-    combined = None
-    for col in key_cols:
-        v = sortable_view(col)
-        if col.valid is not None:
-            # zero data under nulls: all-null rows must compare equal
-            v = jnp.where(col.valid, v, jnp.zeros((), dtype=v.dtype))
-        codes = _dense_codes(v)
-        if col.valid is not None:
-            codes = 2 * codes + (~col.valid).astype(jnp.int64)
-        if combined is None:
-            combined = codes
-        else:
-            # fold and immediately re-densify: both operands stay < 2*plen+2,
-            # so the product below never overflows int64
-            combined = _dense_codes(combined) * fold + codes
-    # pad rows form one trailing group (the sort key exceeds any real code)
-    combined = jnp.where(live, combined, _PAD_GROUP_KEY)
-    gids = _dense_codes(combined)
-    ngroups = int(jnp.max(jnp.where(live, gids, -1))) + 1  # the one host sync
+    views = tuple(sortable_view(c) for c in key_cols)
+    valids = tuple(c.valid for c in key_cols)
+    gids, ng_dev = _group_ids_impl(views, valids, n_valid)
+    ngroups = int(ng_dev)                            # the one host sync
     cap = bucket_len(ngroups)
-    # first occurrence of each live group in row order; pad group dropped
-    scatter_ids = jnp.where(live, gids, cap)
-    rep = jnp.full(cap, plen, dtype=jnp.int64).at[scatter_ids].min(
-        jnp.arange(plen, dtype=jnp.int64))
+    rep = _group_rep_impl(gids, n_valid, cap)
     return gids, ngroups, rep, cap
 
 
@@ -216,21 +281,32 @@ _I64_MIN = jnp.iinfo(jnp.int64).min
 _I64_MAX = jnp.iinfo(jnp.int64).max
 
 
+@functools.partial(jax.jit, static_argnums=(2,))
+def _agg_count_impl(valid, gids, ngroups):
+    ones = (jnp.ones(gids.shape[0], dtype=jnp.int64) if valid is None
+            else valid.astype(jnp.int64))
+    return jax.ops.segment_sum(ones, gids, num_segments=ngroups)
+
+
 def agg_count(col: Column | None, gids, ngroups) -> Column:
     """count(*) when col is None else count(col) (non-null). Pad rows need
     no masking here: grouping routes them to a trailing group that lands
     past the logical group count or is dropped by the segment op."""
-    if col is None:
-        ones = jnp.ones(gids.shape[0], dtype=jnp.int64)
-    else:
-        ones = col.valid_mask().astype(jnp.int64)
-    out = jax.ops.segment_sum(ones, gids, num_segments=ngroups)
-    return Column("i64", out)
+    valid = None if col is None else col.valid
+    return Column("i64", _agg_count_impl(valid, gids, ngroups))
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _agg_sum_impl(data, valid, gids, ngroups, as_f64):
+    v = (jnp.ones(data.shape[0], dtype=bool) if valid is None else valid)
+    d = jnp.where(v, data, 0)
+    d = d if as_f64 else d.astype(jnp.int64)
+    out = jax.ops.segment_sum(d, gids, num_segments=ngroups)
+    cnt = jax.ops.segment_sum(v.astype(jnp.int32), gids, num_segments=ngroups)
+    return out, cnt > 0
 
 
 def agg_sum(col: Column, gids, ngroups) -> Column:
-    valid = col.valid_mask()
-    data = jnp.where(valid, col.data, 0)
     if col.kind == "f64":
         from nds_tpu.engine.kernels import pallas_active, segment_sum_fused
         if pallas_active():
@@ -238,66 +314,84 @@ def agg_sum(col: Column, gids, ngroups) -> Column:
             # the default because validation compares at decimal tolerance).
             # The kernel's counts are per-group valid counts (gid -1 = null),
             # so they double as the result validity mask.
+            valid = col.valid_mask()
             g = jnp.where(valid, gids, -1)
-            sums, counts = segment_sum_fused(data, g, ngroups)
+            sums, counts = segment_sum_fused(
+                jnp.where(valid, col.data, 0), g, ngroups)
             return Column("f64", sums.astype(jnp.float64), counts > 0)
-        out = jax.ops.segment_sum(data, gids, num_segments=ngroups)
-        kind = "f64"
+        out, nonempty = _agg_sum_impl(col.data, col.valid, gids, ngroups, True)
+        return Column("f64", out, nonempty)
+    out, nonempty = _agg_sum_impl(col.data, col.valid, gids, ngroups, False)
+    kind = f"dec(38,{col.scale})" if is_dec(col.kind) else "i64"
+    return Column(kind, out, nonempty)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _agg_min_impl(view, valid, gids, ngroups, is_max):
+    v = (jnp.ones(view.shape[0], dtype=bool) if valid is None else valid)
+    if view.dtype == jnp.float64:
+        sentinel = _F64_MIN if is_max else _F64_MAX
+        work = view
     else:
-        out = jax.ops.segment_sum(data.astype(jnp.int64), gids, num_segments=ngroups)
-        kind = f"dec(38,{col.scale})" if is_dec(col.kind) else "i64"
-    cnt = jax.ops.segment_sum(valid.astype(jnp.int32), gids, num_segments=ngroups)
-    return Column(kind, out, cnt > 0)
+        sentinel = _I64_MIN if is_max else _I64_MAX
+        work = view.astype(jnp.int64)
+    data = jnp.where(v, work, sentinel)
+    seg = jax.ops.segment_max if is_max else jax.ops.segment_min
+    out = seg(data, gids, num_segments=ngroups)
+    cnt = jax.ops.segment_sum(v.astype(jnp.int32), gids, num_segments=ngroups)
+    return out, cnt > 0
 
 
 def agg_min(col: Column, gids, ngroups, is_max=False) -> Column:
-    valid = col.valid_mask()
-    if col.kind == "f64":
-        sentinel = _F64_MIN if is_max else _F64_MAX
-    else:
-        sentinel = _I64_MIN if is_max else _I64_MAX
-    view = sortable_view(col)
-    work = view.astype(jnp.float64) if col.kind == "f64" else view.astype(jnp.int64)
-    data = jnp.where(valid, work, sentinel)
-    seg = jax.ops.segment_max if is_max else jax.ops.segment_min
-    out = seg(data, gids, num_segments=ngroups)
-    cnt = jax.ops.segment_sum(valid.astype(jnp.int32), gids, num_segments=ngroups)
-    out_valid = cnt > 0
+    out, out_valid = _agg_min_impl(sortable_view(col), col.valid, gids,
+                                   ngroups, bool(is_max))
     if col.kind == "str":
         # min/max of strings: map the winning rank back to a dictionary code
-        order = np.argsort(col.dict_values.astype(str), kind="stable")
-        rank_to_code = jnp.asarray(order.astype(np.int64))
-        codes = jnp.take(rank_to_code, jnp.clip(out, 0, len(order) - 1))
+        # (the rank<->code maps are cached per dictionary)
+        rank_to_code = _dict_ranks(col.dict_values)[1]
+        codes = jnp.take(rank_to_code,
+                         jnp.clip(out, 0, rank_to_code.shape[0] - 1))
         return Column("str", codes.astype(jnp.int32), out_valid, col.dict_values)
     if col.kind == "f64":
         return Column("f64", out, out_valid)
     return Column(col.kind, out.astype(col.data.dtype), out_valid)
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def _agg_avg_impl(data, valid, gids, ngroups):
+    v = (jnp.ones(data.shape[0], dtype=bool) if valid is None else valid)
+    d = jnp.where(v, data, 0.0)
+    s = jax.ops.segment_sum(d, gids, num_segments=ngroups)
+    c = jax.ops.segment_sum(v.astype(jnp.float64), gids, num_segments=ngroups)
+    return jnp.where(c > 0, s / jnp.maximum(c, 1.0), 0.0), c > 0
+
+
 def agg_avg(col: Column, gids, ngroups) -> Column:
-    valid = col.valid_mask()
-    data = jnp.where(valid, col.data, 0).astype(jnp.float64)
+    data = col.data.astype(jnp.float64)
     if is_dec(col.kind):
         data = data / (10.0 ** col.scale)
-    s = jax.ops.segment_sum(data, gids, num_segments=ngroups)
-    c = jax.ops.segment_sum(valid.astype(jnp.float64), gids, num_segments=ngroups)
-    out = jnp.where(c > 0, s / jnp.maximum(c, 1.0), 0.0)
-    return Column("f64", out, c > 0)
+    out, nonempty = _agg_avg_impl(data, col.valid, gids, ngroups)
+    return Column("f64", out, nonempty)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _agg_stddev_impl(data, valid, gids, ngroups):
+    v = (jnp.ones(data.shape[0], dtype=bool) if valid is None else valid)
+    d = jnp.where(v, data, 0.0)
+    s1 = jax.ops.segment_sum(d, gids, num_segments=ngroups)
+    s2 = jax.ops.segment_sum(d * d, gids, num_segments=ngroups)
+    c = jax.ops.segment_sum(v.astype(jnp.float64), gids, num_segments=ngroups)
+    mean = s1 / jnp.maximum(c, 1.0)
+    var = (s2 - c * mean * mean) / jnp.maximum(c - 1.0, 1.0)
+    return jnp.sqrt(jnp.maximum(var, 0.0)), c > 1
 
 
 def agg_stddev_samp(col: Column, gids, ngroups) -> Column:
-    valid = col.valid_mask()
-    data = jnp.where(valid, col.data, 0).astype(jnp.float64)
+    data = col.data.astype(jnp.float64)
     if is_dec(col.kind):
         data = data / (10.0 ** col.scale)
-    s1 = jax.ops.segment_sum(data, gids, num_segments=ngroups)
-    s2 = jax.ops.segment_sum(data * data, gids, num_segments=ngroups)
-    c = jax.ops.segment_sum(valid.astype(jnp.float64), gids, num_segments=ngroups)
-    mean = s1 / jnp.maximum(c, 1.0)
-    var = (s2 - c * mean * mean) / jnp.maximum(c - 1.0, 1.0)
-    var = jnp.maximum(var, 0.0)
-    out = jnp.sqrt(var)
-    return Column("f64", out, c > 1)
+    out, enough = _agg_stddev_impl(data, col.valid, gids, ngroups)
+    return Column("f64", out, enough)
 
 
 # ---------------------------------------------------------------------------
@@ -328,40 +422,55 @@ def _mix64(x: jnp.ndarray) -> jnp.ndarray:
     return x ^ (x >> 31)
 
 
-def _key_hash(cols, side_salt: int, null_safe: bool = False,
-              n_valid: int | None = None) -> jnp.ndarray:
-    """64-bit composite hash of the key columns.
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _key_hash_impl(views, valids, side_salt: int, null_safe: bool, n_valid):
+    """64-bit composite hash of prepared key views (see :func:`_hash_views`).
 
     Default SQL join semantics: rows with any null key get a per-row unique
     value that cannot match the other side (null joins nothing). With
     ``null_safe`` (set operations, null-safe equality), the null flag is
     folded into the hash instead so null keys compare equal. Pad rows past
     ``n_valid`` always get the unmatchable per-row value."""
-    n = len(cols[0])
+    n = views[0].shape[0]
     h = jnp.full(n, jnp.uint64(0x243F6A8885A308D3), dtype=jnp.uint64)
     any_null = jnp.zeros(n, dtype=bool)
-    for col in cols:
-        v = col.data
-        if col.kind == "f64":
+    for v, valid in zip(views, valids):
+        if v.dtype == jnp.float64:
             v = jax.lax.bitcast_convert_type(v, jnp.int64)
         v = v.astype(jnp.uint64)
         # the null-marker mix must be applied identically on both join sides,
         # including columns with no mask at all
-        if col.valid is not None:
-            v = jnp.where(col.valid, v, jnp.uint64(0))
-            marker = jnp.where(col.valid, jnp.uint64(0),
+        if valid is not None:
+            v = jnp.where(valid, v, jnp.uint64(0))
+            marker = jnp.where(valid, jnp.uint64(0),
                                jnp.uint64(0xA5A5A5A5A5A5A5A5))
-            any_null = any_null | ~col.valid
+            any_null = any_null | ~valid
         else:
             marker = jnp.zeros(n, dtype=jnp.uint64)
         h = _mix64(h ^ marker)
         h = _mix64(h ^ v * jnp.uint64(_HASH_C1))
     unmatchable = jnp.zeros(n, dtype=bool) if null_safe else any_null
-    if n_valid is not None and n_valid < n:
-        unmatchable = unmatchable | ~live_mask(n, n_valid)
+    unmatchable = unmatchable | (jnp.arange(n) >= n_valid)
     row_ids = jnp.arange(n, dtype=jnp.uint64)
     sentinel = jnp.uint64(1 if side_salt else 2) + (row_ids << jnp.uint64(2))
     return jnp.where(unmatchable, sentinel, h | jnp.uint64(4))
+
+
+def _hash_views(left_keys, right_keys):
+    """Per-pair hashable views of the join keys. String pairs are mapped
+    through one merged dictionary ordering first: the per-column dictionary
+    codes of the two sides are NOT comparable (equal strings get different
+    codes), so hashing raw codes would silently drop every cross-dictionary
+    match."""
+    lviews, rviews = [], []
+    for lk, rk in zip(left_keys, right_keys):
+        if lk.kind == "str" and rk.kind == "str":
+            lv, rv = ordered_codes_merged(lk, rk)
+        else:
+            lv, rv = lk.data, rk.data
+        lviews.append(lv)
+        rviews.append(rv)
+    return tuple(lviews), tuple(rviews)
 
 
 def _verify_pairs(l_idx, r_idx, left_keys, right_keys,
@@ -396,28 +505,20 @@ def _verify_pairs(l_idx, r_idx, left_keys, right_keys,
 
 
 _merged_cache: dict = {}
-_MERGED_CACHE_MAX = 256
 
 
 def ordered_codes_merged(a: Column, b: Column):
-    """Map two string columns' codes into one shared value ordering.
-
-    Cached by identity of the two dictionaries; the cache holds references to
-    the keyed arrays so a recycled id can never alias a freed dictionary, and
-    it is bounded (FIFO evict) so long benchmark runs don't leak."""
-    key = (id(a.dict_values), id(b.dict_values))
-    hit = _merged_cache.get(key)
-    if hit is not None and hit[0] is a.dict_values and hit[1] is b.dict_values:
-        _, _, a_map, b_map = hit
-    else:
+    """Map two string columns' codes into one shared value ordering, cached
+    per dictionary pair."""
+    def compute():
         union, inverse = np.unique(
             np.concatenate([a.dict_values.astype(str), b.dict_values.astype(str)]),
             return_inverse=True)
         a_map = jnp.asarray(inverse[: len(a.dict_values)].astype(np.int64))
         b_map = jnp.asarray(inverse[len(a.dict_values):].astype(np.int64))
-        if len(_merged_cache) >= _MERGED_CACHE_MAX:
-            _merged_cache.pop(next(iter(_merged_cache)))
-        _merged_cache[key] = (a.dict_values, b.dict_values, a_map, b_map)
+        return a_map, b_map
+    a_map, b_map = _identity_cache(
+        _merged_cache, 256, (a.dict_values, b.dict_values), compute)
     return jnp.take(a_map, a.data), jnp.take(b_map, b.data)
 
 
@@ -433,8 +534,11 @@ def join_indices(left_keys, right_keys, how: str = "inner",
     plen_r = len(right_keys[0])
     n_left = plen_l if n_left is None else n_left
     n_right = plen_r if n_right is None else n_right
-    lh = _key_hash(left_keys, 0, null_safe, n_left)
-    rh = _key_hash(right_keys, 1, null_safe, n_right)
+    lviews, rviews = _hash_views(left_keys, right_keys)
+    lvalids = tuple(c.valid for c in left_keys)
+    rvalids = tuple(c.valid for c in right_keys)
+    lh = _key_hash_impl(lviews, lvalids, 0, null_safe, n_left)
+    rh = _key_hash_impl(rviews, rvalids, 1, null_safe, n_right)
     order = jnp.argsort(rh)
     rh_sorted = jnp.take(rh, order)
     lo = jnp.searchsorted(rh_sorted, lh, side="left")

@@ -786,7 +786,9 @@ class Planner:
         raise ExecError(f"unsupported aggregate {name}")
 
     def _count_distinct(self, arg: Column, gids, ng, n_base: int):
-        if len(arg) == 0:
+        # empty-input fallback: gids comes from the zero-length path, but the
+        # padded arg still has plen >= 16, so test the base row count
+        if n_base == 0 or gids.shape[0] == 0:
             return Column("i64", jnp.zeros(ng, dtype=jnp.int64))
         gid_col = Column("i64", gids)
         inner_gids, inner_ng, inner_rep, inner_cap = E.group_ids(
@@ -801,7 +803,7 @@ class Planner:
         return Column("i64", out)
 
     def _sum_avg_distinct(self, name, arg: Column, gids, ng, n_base: int):
-        if len(arg) == 0:
+        if n_base == 0 or gids.shape[0] == 0:
             return Column("f64" if name == "avg" else arg.kind,
                           jnp.zeros(ng, dtype=jnp.float64 if name == "avg" else jnp.int64))
         gid_col = Column("i64", gids)

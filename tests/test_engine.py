@@ -258,3 +258,17 @@ def test_union_all_dict_merge():
     out = E.concat_tables([t1, t2])
     vals = out["s"].dict_values[np.asarray(out["s"].data)][:out.nrows]
     assert list(vals) == ["a", "b", "a", "c", "b"]
+
+
+def test_string_join_across_dictionaries():
+    """Equal strings must join even when each side's dictionary assigns
+    different codes (raw-code hashing would silently drop every match)."""
+    lt = dev(pa.table({"a": pa.array(["x", "y", "z"])}))
+    rt = dev(pa.table({"b": pa.array(["q", "z", "x"]), "v": pa.array([1, 2, 3])}))
+    out = E.join_tables(lt, rt, ["a"], ["b"], "inner")
+    assert out.nrows == 2
+    got = out.to_arrow().to_pydict()
+    assert sorted(zip(got["a"], got["v"])) == [("x", 3), ("z", 2)]
+    semi = E.semi_join_mask([lt["a"]], [rt["b"]],
+                            n_left=lt.nrows, n_right=rt.nrows)
+    assert [bool(x) for x in semi[:3]] == [True, False, True]

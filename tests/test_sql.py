@@ -382,3 +382,16 @@ def test_semi_join_residual_condition(sess):
     assert set(out["item_sk"]) <= books
     exp = sess._dfs["sales"][sess._dfs["sales"]["item_sk"].isin(books)]
     assert len(out) == len(exp)
+
+
+def test_distinct_agg_over_empty_input(session_factory=None):
+    """Grouped DISTINCT aggregates over a filter that matches nothing must
+    return an empty result, not crash on the zero-length group path."""
+    import pyarrow as pa
+    from nds_tpu.engine.session import Session
+    s = Session()
+    s.create_temp_view("t", pa.table({"k": pa.array([1, 2, 3]),
+                                      "v": pa.array([10, 20, 30])}))
+    out = s.sql("select k, count(distinct v), sum(distinct v), "
+                "avg(distinct v) from t where v > 100 group by k")
+    assert out.collect() == []
