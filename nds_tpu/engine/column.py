@@ -222,13 +222,19 @@ def from_arrow(table: pa.Table, canonical_types: dict | None = None):
 # device -> arrow
 # ---------------------------------------------------------------------------
 
+def _slice_col(col: Column, nrows: int | None) -> Column:
+    """Slice off the bucket-padding suffix (padded-prefix invariant)."""
+    if nrows is None or nrows >= col.data.shape[0]:
+        return col
+    return replace(col, data=col.data[:nrows],
+                   valid=None if col.valid is None else col.valid[:nrows])
+
+
 def column_to_arrow(col: Column, nrows: int | None = None) -> pa.Array:
-    """Device -> arrow; ``nrows`` slices off the bucket-padding suffix
-    (padded-prefix invariant) before the host transfer."""
-    if nrows is not None and nrows < col.data.shape[0]:
-        col = replace(
-            col, data=col.data[:nrows],
-            valid=None if col.valid is None else col.valid[:nrows])
+    """Device -> arrow; ``nrows`` drops the padding before the transfer."""
+    col = _slice_col(col, nrows)
+    if not isinstance(col.data, np.ndarray):     # not already fetched
+        col = _fetch_columns([col])[0]
     valid_np = None if col.valid is None else np.asarray(col.valid)
 
     if col.kind == "str":
@@ -262,10 +268,21 @@ def column_to_arrow(col: Column, nrows: int | None = None) -> pa.Array:
     return pa.array(data_np, type=pa_type, mask=mask)
 
 
+def _fetch_columns(cols):
+    """Materialize device buffers on host in ONE transfer round trip
+    (``jax.device_get`` of the whole tree), returning Columns whose
+    data/valid are host numpy arrays."""
+    import jax
+
+    tree = [(c.data, c.valid) for c in cols]
+    fetched = jax.device_get(tree)
+    return [replace(c, data=d, valid=v)
+            for c, (d, v) in zip(cols, fetched)]
+
+
 def to_arrow(dt) -> pa.Table:
     """DeviceTable -> arrow Table."""
-    arrays, names = [], []
-    for name, col in dt.columns.items():
-        names.append(name)
-        arrays.append(column_to_arrow(col, dt.nrows))
-    return pa.table(arrays, names=names)
+    cols = [_slice_col(c, dt.nrows) for c in dt.columns.values()]
+    cols = _fetch_columns(cols)   # one device->host round trip for the table
+    arrays = [column_to_arrow(c) for c in cols]
+    return pa.table(arrays, names=list(dt.columns.keys()))

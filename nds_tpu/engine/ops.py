@@ -69,13 +69,16 @@ def compact_table(table: DeviceTable, mask: jnp.ndarray) -> DeviceTable:
 
 def take_padded(table: DeviceTable, idx: jnp.ndarray, nrows: int) -> DeviceTable:
     """Gather rows by (possibly out-of-range padded) ``idx``; logical length
-    ``nrows``."""
+    ``nrows``. The physical length follows ``idx`` (already bucketed by the
+    callers), including for column-less tables, so the plen floor survives
+    compaction."""
+    cap = int(idx.shape[0])
     if table.plen == 0:
-        cols = {n: _null_column_like(c, int(idx.shape[0]))
+        cols = {n: _null_column_like(c, cap)
                 for n, c in table.columns.items()}
-        return DeviceTable(cols, 0)
+        return DeviceTable(cols, 0, plen=cap)
     cols = {n: c.take(idx) for n, c in table.columns.items()}
-    return DeviceTable(cols, nrows)
+    return DeviceTable(cols, nrows, plen=cap)
 
 
 # ---------------------------------------------------------------------------
@@ -423,14 +426,17 @@ def _mix64(x: jnp.ndarray) -> jnp.ndarray:
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
-def _key_hash_impl(views, valids, side_salt: int, null_safe: bool, n_valid):
+def _key_hash_impl(views, valids, side_salt: int, null_safe: bool, n_valid,
+                   excluded=None):
     """64-bit composite hash of prepared key views (see :func:`_hash_views`).
 
     Default SQL join semantics: rows with any null key get a per-row unique
     value that cannot match the other side (null joins nothing). With
     ``null_safe`` (set operations, null-safe equality), the null flag is
     folded into the hash instead so null keys compare equal. Pad rows past
-    ``n_valid`` always get the unmatchable per-row value."""
+    ``n_valid``, and rows flagged in ``excluded`` (a deferred filter mask the
+    planner chose not to materialize), always get the unmatchable per-row
+    value."""
     n = views[0].shape[0]
     h = jnp.full(n, jnp.uint64(0x243F6A8885A308D3), dtype=jnp.uint64)
     any_null = jnp.zeros(n, dtype=bool)
@@ -451,6 +457,8 @@ def _key_hash_impl(views, valids, side_salt: int, null_safe: bool, n_valid):
         h = _mix64(h ^ v * jnp.uint64(_HASH_C1))
     unmatchable = jnp.zeros(n, dtype=bool) if null_safe else any_null
     unmatchable = unmatchable | (jnp.arange(n) >= n_valid)
+    if excluded is not None:
+        unmatchable = unmatchable | excluded
     row_ids = jnp.arange(n, dtype=jnp.uint64)
     sentinel = jnp.uint64(1 if side_salt else 2) + (row_ids << jnp.uint64(2))
     return jnp.where(unmatchable, sentinel, h | jnp.uint64(4))
@@ -524,11 +532,15 @@ def ordered_codes_merged(a: Column, b: Column):
 
 def join_indices(left_keys, right_keys, how: str = "inner",
                  null_safe: bool = False,
-                 n_left: int | None = None, n_right: int | None = None):
+                 n_left: int | None = None, n_right: int | None = None,
+                 l_excl=None, r_excl=None):
     """Equi-join. Returns ``(l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra,
     n_rx)``: bucket-padded matched pair indices with their logical count,
     plus (for outer joins) the bucket-padded unmatched row indices of each
     side. Pad slots hold out-of-range indices (gathers clip, scatters drop).
+    ``l_excl``/``r_excl`` are deferred filter masks (True = row filtered
+    out): such rows join nothing, which lets the planner push a filter into
+    the join without a compaction sync.
     """
     plen_l = len(left_keys[0])
     plen_r = len(right_keys[0])
@@ -537,8 +549,8 @@ def join_indices(left_keys, right_keys, how: str = "inner",
     lviews, rviews = _hash_views(left_keys, right_keys)
     lvalids = tuple(c.valid for c in left_keys)
     rvalids = tuple(c.valid for c in right_keys)
-    lh = _key_hash_impl(lviews, lvalids, 0, null_safe, n_left)
-    rh = _key_hash_impl(rviews, rvalids, 1, null_safe, n_right)
+    lh = _key_hash_impl(lviews, lvalids, 0, null_safe, n_left, l_excl)
+    rh = _key_hash_impl(rviews, rvalids, 1, null_safe, n_right, r_excl)
     order = jnp.argsort(rh)
     rh_sorted = jnp.take(rh, order)
     lo = jnp.searchsorted(rh_sorted, lh, side="left")
@@ -572,12 +584,16 @@ def join_indices(left_keys, right_keys, how: str = "inner",
         matched = jnp.zeros(plen_l, dtype=bool).at[l_idx].set(
             True, mode="drop")
         miss = ~matched & live_mask(plen_l, n_left)
+        if l_excl is not None:
+            miss = miss & ~l_excl
         n_lx = int(jnp.sum(miss))
         l_extra = compact_indices(miss, n_lx)
     if how in ("right", "full"):
         matched_r = jnp.zeros(plen_r, dtype=bool).at[r_idx].set(
             True, mode="drop")
         miss_r = ~matched_r & live_mask(plen_r, n_right)
+        if r_excl is not None:
+            miss_r = miss_r & ~r_excl
         n_rx = int(jnp.sum(miss_r))
         r_extra = compact_indices(miss_r, n_rx)
     return l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx
@@ -605,12 +621,14 @@ def _null_column_like(col: Column, n: int) -> Column:
 
 
 def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
-                how: str = "inner") -> DeviceTable:
+                how: str = "inner", l_excl=None, r_excl=None) -> DeviceTable:
     """Materialized equi-join of two tables; column name collisions must be
-    resolved by the caller (planner aliases)."""
+    resolved by the caller (planner aliases). ``l_excl``/``r_excl`` fold
+    deferred filter masks into the join (see :func:`join_indices`)."""
     l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx = join_indices(
         [left[c] for c in left_on], [right[c] for c in right_on], how,
-        n_left=left.nrows, n_right=right.nrows)
+        n_left=left.nrows, n_right=right.nrows,
+        l_excl=l_excl, r_excl=r_excl)
     matched = DeviceTable(
         {**{n: c.take(l_idx) for n, c in left.columns.items()},
          **{n: c.take(r_idx) for n, c in right.columns.items()}}, n_pairs)

@@ -14,6 +14,7 @@ by unique suffix match, mirroring SQL scoping.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 import jax.numpy as jnp
@@ -30,6 +31,13 @@ from nds_tpu.sql.parser import expr_key
 
 class ExecError(ValueError):
     pass
+
+
+# Defer pushed-down filters into the join hash (no compaction sync) up to
+# this physical size; above it, compaction pays for itself by shrinking the
+# join's sort/probe width.
+_DEFER_FILTER_MAX_ROWS = int(
+    os.environ.get("NDS_TPU_DEFER_FILTER_MAX_ROWS", 1 << 21))
 
 
 @dataclass
@@ -173,7 +181,7 @@ class Planner:
         optimizer in select()."""
         if from_ is None:
             # SELECT without FROM: single virtual row
-            return DeviceTable({}, 1)
+            return DeviceTable({}, 1, plen=E.bucket_len(1))
         parts, join_preds = self._flatten_from(from_)
         return self._join_parts(parts, join_preds, [])
 
@@ -539,7 +547,21 @@ class Planner:
             else:
                 residual.append(c)
 
-        parts = [self._filter_conjuncts(p, f) for p, f in zip(parts, filters_per_part)]
+        # deferred filter materialization: keep each part's pushed-down
+        # predicate as a boolean mask and fold it into its first equi-join
+        # (filtered rows hash as unmatchable), skipping one compaction sync
+        # and a full-width gather per filtered part. Big parts compact
+        # up front instead so join sorts don't run at raw-table width.
+        masks = []
+        tables = list(parts)
+        for i, (p, f) in enumerate(zip(parts, filters_per_part)):
+            if not f:
+                masks.append(None)
+            elif p.plen > _DEFER_FILTER_MAX_ROWS:
+                tables[i] = self._filter_conjuncts(p, f)
+                masks.append(None)
+            else:
+                masks.append(~self._conjunct_mask(p, f))
 
         # iteratively merge parts along equi edges
         groups = list(range(len(parts)))  # part index -> current table slot
@@ -549,7 +571,6 @@ class Planner:
                 i = groups[i]
             return i
 
-        tables = list(parts)
         pending = list(edges)
         while pending:
             # gather every edge connecting the same two slots in one join
@@ -564,11 +585,18 @@ class Planner:
             (a, b), es = next(iter(by_slots.items()))
             l_on = [lk if sl == a else rk for (sl, sr, lk, rk) in es]
             r_on = [rk if sl == a else lk for (sl, sr, lk, rk) in es]
-            tables[a] = E.join_tables(tables[a], tables[b], l_on, r_on, "inner")
+            tables[a] = E.join_tables(tables[a], tables[b], l_on, r_on, "inner",
+                                      l_excl=masks[a], r_excl=masks[b])
+            masks[a] = masks[b] = None       # consumed by the join
             groups[b] = a
             pending = [e for e in pending if slot(e[0]) != slot(e[1])]
-        # cartesian any remaining disconnected slots
+        # cartesian any remaining disconnected slots (materialize any
+        # still-deferred mask first)
         live = sorted({slot(i) for i in range(len(parts))})
+        for s in live:
+            if masks[s] is not None:
+                tables[s] = E.compact_table(tables[s], ~masks[s])
+                masks[s] = None
         out = tables[live[0]]
         for s in live[1:]:
             out = self._cartesian(out, tables[s])
@@ -583,7 +611,7 @@ class Planner:
         where_conjuncts = [h for c in self._split_conjuncts(sel.where)
                            for h in self._hoist_or_conjuncts(c)]
         if sel.from_ is None:
-            table = DeviceTable({}, 1)
+            table = DeviceTable({}, 1, plen=E.bucket_len(1))
             table = self._filter_conjuncts(table, where_conjuncts)
         else:
             table = self._join_parts(parts, join_preds, where_conjuncts)
@@ -724,14 +752,22 @@ class Planner:
             out = self._project(sel, post)
             set_tables.append((out, post))
         if not set_tables:
-            # grouped query over empty input -> empty result with right names
-            post = EvalCtx(DeviceTable({}, 0), post_agg=True)
+            # grouped query over empty input -> empty result with right
+            # names. Keep the physical floor bucket (plen >= 16, nrows = 0):
+            # a zero-length physical table would break the padded-prefix
+            # invariant every downstream consumer (joins, sorts) relies on.
+            cap0 = E.bucket_len(0)
+            post = EvalCtx(DeviceTable({}, 0, plen=cap0), post_agg=True)
+            pad_idx = jnp.full(cap0, base_ctx.table.plen, dtype=jnp.int64)
             for kname, kcol in zip(key_names, key_cols):
-                post.group_values[kname] = kcol.take(jnp.zeros(0, dtype=jnp.int64))
+                post.group_values[kname] = (
+                    kcol.take(pad_idx) if len(kcol)
+                    else E._null_column_like(kcol, cap0))
                 post.grouping_flags[kname] = 0
+            gids0 = jnp.full(base_ctx.table.plen, cap0, dtype=jnp.int64)
             for akey, call in agg_calls.items():
                 post.agg_values[akey] = self._compute_agg(
-                    call, base_ctx, jnp.zeros(0, dtype=jnp.int64), 0, [])
+                    call, base_ctx, gids0, cap0, [])
             self._eval_windows(sel, post)
             out = self._project(sel, post)
             return out, post

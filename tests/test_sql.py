@@ -384,7 +384,7 @@ def test_semi_join_residual_condition(sess):
     assert len(out) == len(exp)
 
 
-def test_distinct_agg_over_empty_input(session_factory=None):
+def test_distinct_agg_over_empty_input():
     """Grouped DISTINCT aggregates over a filter that matches nothing must
     return an empty result, not crash on the zero-length group path."""
     import pyarrow as pa
