@@ -23,6 +23,7 @@ compile-once-run-many analog of the reference's warmed JVM+plugin
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -35,11 +36,15 @@ from nds_tpu.engine.table import DeviceTable
 # bucketed shapes
 # ---------------------------------------------------------------------------
 
-_MIN_BUCKET = 16
+# Floor of every physical bucket. Meshes shard buckets row-wise, so a mesh
+# wider than the floor needs it raised (NDS_TPU_MIN_BUCKET, power of two) at
+# process start — it is a process-wide shape contract, never mutated at run
+# time.
+_MIN_BUCKET = int(os.environ.get("NDS_TPU_MIN_BUCKET", "16"))
 
 
 def bucket_len(n: int) -> int:
-    """Smallest power-of-two capacity >= n (floor 16)."""
+    """Smallest power-of-two capacity >= n (floor ``_MIN_BUCKET``)."""
     if n <= _MIN_BUCKET:
         return _MIN_BUCKET
     return 1 << (int(n) - 1).bit_length()

@@ -51,6 +51,8 @@ class Result:
 
 class Session:
     def __init__(self, conf: dict | None = None):
+        import os
+
         from nds_tpu import enable_compile_cache
         enable_compile_cache()   # backend is resolved by session time
         self.conf = dict(conf or {})
@@ -61,13 +63,53 @@ class Session:
         # (ref: nds/nds_power.py:246,265)
         self.app_id = f"nds-tpu-{int(time.time() * 1000)}"
         self.app_name = "nds-tpu"
+        # SPMD execution: with a >1 mesh (power-of-two device count; the
+        # launch templates export NDS_MESH_SHAPE, base.template), base-table
+        # columns are row-sharded over the mesh and GSPMD partitions every
+        # engine primitive, inserting ICI collectives where Spark would
+        # shuffle (SURVEY.md §2.4.1, §5.8). Bucketed physical lengths are
+        # powers of two >= 16, so any such mesh divides them evenly.
+        self.mesh = None
+        shape = int(self.conf.get("mesh_shape") or
+                    os.environ.get("NDS_MESH_SHAPE", "1"))
+        if shape > 1:
+            if shape & (shape - 1):
+                raise ValueError(f"mesh_shape must be a power of two, "
+                                 f"got {shape}")
+            # every physical bucket must divide evenly across the mesh; the
+            # floor is a process-wide shape contract, so it is configured by
+            # environment (NDS_TPU_MIN_BUCKET) at import, never mutated here
+            from nds_tpu.engine import ops as _ops
+            if shape > _ops._MIN_BUCKET:
+                raise ValueError(
+                    f"mesh_shape {shape} exceeds the physical bucket floor "
+                    f"{_ops._MIN_BUCKET}; start the process with "
+                    f"NDS_TPU_MIN_BUCKET={shape} (or larger power of two)")
+            from nds_tpu.parallel import make_mesh
+            self.mesh = make_mesh(shape)
 
     # -- catalog ------------------------------------------------------------
+
+    def _shard_table(self, table: DeviceTable) -> DeviceTable:
+        """Row-shard every column over the session mesh (no-op without
+        one)."""
+        if self.mesh is None:
+            return table
+        import jax
+        from dataclasses import replace as _replace
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sh = NamedSharding(self.mesh, P("part"))
+        cols = {}
+        for n, c in table.columns.items():
+            cols[n] = _replace(
+                c, data=jax.device_put(c.data, sh),
+                valid=None if c.valid is None else jax.device_put(c.valid, sh))
+        return DeviceTable(cols, table.nrows, plen=table.plen)
 
     def create_temp_view(self, name: str, table) -> None:
         if isinstance(table, pa.Table):
             table = from_arrow(table)
-        self.catalog[name.lower()] = table
+        self.catalog[name.lower()] = self._shard_table(table)
 
     def read_raw_view(self, name: str, path: str, fields) -> float:
         """Register a raw '|'-delimited table; returns elapsed seconds (the
@@ -103,8 +145,10 @@ class Session:
                 raise ExecError("INSERT requires an attached warehouse")
             rows = planner.query(stmt.query)
             self.warehouse.insert(stmt.table, rows.to_arrow())
-            self.catalog[stmt.table.lower()] = from_arrow(
-                self.warehouse.read(stmt.table))
+            # route through create_temp_view so a meshed session re-shards
+            # the refreshed table like every other catalog entry
+            self.create_temp_view(stmt.table,
+                                  from_arrow(self.warehouse.read(stmt.table)))
             return Result(DeviceTable({}, 0))
         if isinstance(stmt, A.DeleteFrom):
             if self.warehouse is None:
@@ -122,6 +166,6 @@ class Session:
                 keep_mask = ~mask
             kept = E.compact_table(table, keep_mask)
             self.warehouse.overwrite(stmt.table, kept.to_arrow())
-            self.catalog[stmt.table.lower()] = kept
+            self.create_temp_view(stmt.table, kept)
             return Result(DeviceTable({}, 0))
         raise ExecError(f"unsupported statement {type(stmt).__name__}")
