@@ -37,10 +37,15 @@ from nds_tpu.engine.table import DeviceTable
 # ---------------------------------------------------------------------------
 
 # Floor of every physical bucket. Meshes shard buckets row-wise, so a mesh
-# wider than the floor needs it raised (NDS_TPU_MIN_BUCKET, power of two) at
-# process start — it is a process-wide shape contract, never mutated at run
-# time.
-_MIN_BUCKET = int(os.environ.get("NDS_TPU_MIN_BUCKET", "16"))
+# wider than the floor needs it raised (NDS_TPU_MIN_BUCKET) at process
+# start — it is a process-wide shape contract, never mutated at run time.
+# Rounded up to a power of two so every bucket divides any power-of-two
+# mesh up to the floor.
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(int(n) - 1, 1).bit_length() if n > 2 else 2
+
+
+_MIN_BUCKET = _pow2_ceil(int(os.environ.get("NDS_TPU_MIN_BUCKET", "16")))
 
 
 def bucket_len(n: int) -> int:
@@ -138,31 +143,51 @@ def sortable_view(col: Column) -> jnp.ndarray:
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
 def _lexsort_impl(views, valids, descending, nulls_last, pad_key, n_valid):
-    """Jit-fused key assembly + variadic sort. ``views`` are numeric
-    sortable views (host-side string ranking already applied); ``valids``
-    is a tuple of masks-or-None (structure is static); flag tuples are
-    static. One XLA program per (arity, null pattern, flags, bucket)."""
+    """Jit-fused multi-key sort by iterative order-preserving re-coding.
+
+    ``views`` are numeric sortable views (host-side string ranking already
+    applied); ``valids`` is a tuple of masks-or-None (structure is static);
+    flag tuples are static.
+
+    Instead of one variadic sort over up to 2k+1 operands — whose XLA:TPU
+    comparator compile time grows superlinearly in operand count and has
+    hung the remote compiler outright on ORDER BY clauses with many keys
+    (the same failure mode iterative re-coding fixed for q4-class GROUP
+    BYs) — each key folds into one combined int64 code via
+    :func:`_dense_codes` (codes are assigned in ascending value order, so
+    folding ``dense(combined)*fold + code`` preserves lexicographic order),
+    and a single stable single-key argsort finishes. Every fold reuses the
+    same single-key sort executable.
+    """
     n = views[0].shape[0]
-    keys = []  # build primary-first, then reverse for lexsort
+    fold = jnp.int64(2 * n + 4)
+    combined = None
     if pad_key:
-        keys.append(jnp.arange(n) >= n_valid)   # False (live) first
+        combined = (jnp.arange(n) >= n_valid).astype(jnp.int64)  # live first
     for v, valid, desc, nl in zip(views, valids, descending, nulls_last):
-        if v.dtype != jnp.float64:
-            v = v.astype(jnp.int64)
+        # _dense_codes sorts the key in its own dtype (f64 keys sort as
+        # floats — no s64 bitcast, which the TPU x64-emulation pass cannot
+        # compile) and yields int64 codes in ascending value order
         if desc:
-            v = -v
-        null_rank_when_null = 1 if nl else -1
+            v = -v.astype(jnp.int64) if v.dtype != jnp.float64 else -v
+        if v.dtype == jnp.float64:
+            # NaNs must compare EQUAL (one code, greatest — Spark's float
+            # ordering) so later keys can still break their ties; boundary
+            # detection via != would give every NaN its own code
+            nan = jnp.isnan(v)
+            c = _dense_codes(jnp.where(nan, jnp.inf, v))
+            code = 2 * c + nan.astype(jnp.int64) + 1      # 1..2n
+        else:
+            code = _dense_codes(v) + 1                    # 1..n
         if valid is not None:
-            nullk = jnp.where(valid, 0, null_rank_when_null)
-            # zero the value under nulls so the value tiebreak is stable
-            v = jnp.where(valid, v, jnp.zeros((), dtype=v.dtype))
-            # null flag outranks the value within each sort key
-            keys.append(nullk)
-        # (a column with no null mask needs no flag key — each flag key is a
-        # whole extra stable-sort pass inside lexsort)
-        keys.append(v)
-    # jnp.lexsort: last key is primary => reverse (primary-first -> last)
-    return jnp.lexsort(tuple(reversed(keys)))
+            # null sentinels sit outside every real code (max 2n < 2n+3)
+            code = jnp.where(valid, code,
+                             jnp.int64(2 * n + 3) if nl else jnp.int64(0))
+        combined = code if combined is None else \
+            _dense_codes(combined) * fold + code
+    if combined is None:
+        return jnp.arange(n)
+    return jnp.argsort(combined, stable=True)
 
 
 def lexsort_indices(cols, descending=None, nulls_last=None,
@@ -447,7 +472,12 @@ def _key_hash_impl(views, valids, side_salt: int, null_safe: bool, n_valid,
     any_null = jnp.zeros(n, dtype=bool)
     for v, valid in zip(views, valids):
         if v.dtype == jnp.float64:
-            v = jax.lax.bitcast_convert_type(v, jnp.int64)
+            # equality-preserving (not injective) int map: the hash is only
+            # a candidate prefilter (_verify_pairs compares exactly), and a
+            # f64->s64 bitcast would not compile under the TPU x64-emulation
+            # rewrite
+            v = jnp.clip(jnp.nan_to_num(v * 4096.0),
+                         -9.0e18, 9.0e18).astype(jnp.int64)
         v = v.astype(jnp.uint64)
         # the null-marker mix must be applied identically on both join sides,
         # including columns with no mask at all

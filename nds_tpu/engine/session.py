@@ -85,6 +85,12 @@ class Session:
                     f"mesh_shape {shape} exceeds the physical bucket floor "
                     f"{_ops._MIN_BUCKET}; start the process with "
                     f"NDS_TPU_MIN_BUCKET={shape} (or larger power of two)")
+            import jax
+            n_avail = len(jax.devices())
+            if n_avail < shape:
+                raise ValueError(
+                    f"mesh_shape {shape} exceeds the {n_avail} available "
+                    f"device(s); silent truncation would under-shard")
             from nds_tpu.parallel import make_mesh
             self.mesh = make_mesh(shape)
 
@@ -138,7 +144,9 @@ class Session:
         if isinstance(stmt, A.Query):
             return Result(planner.query(stmt))
         if isinstance(stmt, A.CreateTempView):
-            self.catalog[stmt.name.lower()] = planner.query(stmt.query)
+            # route through create_temp_view so a meshed session re-shards
+            # the view like every other catalog entry
+            self.create_temp_view(stmt.name, planner.query(stmt.query))
             return Result(DeviceTable({}, 0))
         if isinstance(stmt, A.InsertInto):
             if self.warehouse is None:

@@ -272,3 +272,17 @@ def test_string_join_across_dictionaries():
     semi = E.semi_join_mask([lt["a"]], [rt["b"]],
                             n_left=lt.nrows, n_right=rt.nrows)
     assert [bool(x) for x in semi[:3]] == [True, False, True]
+
+
+def test_float_sort_nan_ties_break_on_secondary_key():
+    """NaNs compare equal in the sort (one code, greatest) so the secondary
+    key still orders the tied rows."""
+    f = pa.table({
+        "x": pa.array([float("nan"), 1.5, float("nan"), float("nan"), 0.5]),
+        "y": pa.array([3, 9, 1, 2, 9]),
+    })
+    dt = dev(f)
+    out = E.sort_table(dt, ["x", "y"])
+    got = out.to_arrow().to_pydict()["y"]
+    # 0.5, 1.5 first; the three NaN rows ordered by y
+    assert got == [9, 9, 1, 2, 3]

@@ -1,0 +1,14 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""License-header compliance (the reference's only functional CI gate;
+ref: license-check/license-check.py:27-48)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+
+def test_every_source_file_has_license_header():
+    import license_check
+    missing = license_check.missing_header()
+    assert missing == [], f"files missing Apache header: {missing}"

@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""License-header gate: every source file must carry the Apache-2.0 header.
+
+The compliance check the reference enforces in CI (its only functional CI
+gate; ref: .github/workflows/license-header-check.yml and
+license-check/license-check.py:27-48 — every file except docs/data must
+contain the Apache header). Run directly or via tests/test_license.py.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HEADER_MARK = "Licensed under the Apache License"
+
+CHECKED_SUFFIXES = (".py", ".cc", ".h", ".template")
+CHECKED_BARE = ("nds-throughput", "nds-run-template")
+SKIP_DIRS = {".git", ".bench_cache", "__pycache__", ".pytest_cache",
+             ".claude", "node_modules"}
+
+
+def checked_files():
+    for root, dirs, files in os.walk(REPO):
+        dirs[:] = [d for d in dirs if d not in SKIP_DIRS]
+        for f in files:
+            if f.endswith(CHECKED_SUFFIXES) or f in CHECKED_BARE:
+                yield os.path.join(root, f)
+
+
+def missing_header():
+    out = []
+    for path in checked_files():
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                head = fh.read(2048)
+        except OSError:
+            continue
+        if HEADER_MARK not in head:
+            out.append(os.path.relpath(path, REPO))
+    return out
+
+
+def main() -> int:
+    bad = missing_header()
+    for p in bad:
+        print(f"missing license header: {p}")
+    print(f"checked OK" if not bad else f"{len(bad)} file(s) missing header")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
