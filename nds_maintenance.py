@@ -156,7 +156,8 @@ def register_warehouse_tables(session, warehouse):
     from nds_tpu.engine.column import from_arrow
     session.warehouse = warehouse
     for table in warehouse.tables():
-        session.create_temp_view(table, from_arrow(warehouse.read(table)))
+        session.create_temp_view(table, from_arrow(warehouse.read(table)),
+                                 base=True)
 
 
 def register_temp_views(session, refresh_data_path):

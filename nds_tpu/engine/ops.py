@@ -472,24 +472,29 @@ def _key_hash_impl(views, valids, side_salt: int, null_safe: bool, n_valid,
     any_null = jnp.zeros(n, dtype=bool)
     for v, valid in zip(views, valids):
         if v.dtype == jnp.float64:
-            # equality-preserving (not injective) int map: the hash is only
-            # a candidate prefilter (_verify_pairs compares exactly), and a
-            # f64->s64 bitcast would not compile under the TPU x64-emulation
-            # rewrite
-            v = jnp.clip(jnp.nan_to_num(v * 4096.0),
-                         -9.0e18, 9.0e18).astype(jnp.int64)
-        v = v.astype(jnp.uint64)
+            # equality-preserving int words (the hash is only a candidate
+            # prefilter — _verify_pairs compares exactly — and a f64->s64
+            # bitcast does not compile under the TPU x64-emulation rewrite):
+            # the integer part plus a 52-bit fraction word keep distinct
+            # doubles in distinct buckets at full double resolution
+            vf = jnp.nan_to_num(v)
+            ip = jnp.clip(vf, -9.0e18, 9.0e18).astype(jnp.int64)
+            frac = ((vf - jnp.floor(vf)) * float(2 ** 52)).astype(jnp.int64)
+            words = (ip.astype(jnp.uint64), frac.astype(jnp.uint64))
+        else:
+            words = (v.astype(jnp.uint64),)
         # the null-marker mix must be applied identically on both join sides,
         # including columns with no mask at all
         if valid is not None:
-            v = jnp.where(valid, v, jnp.uint64(0))
+            words = tuple(jnp.where(valid, w, jnp.uint64(0)) for w in words)
             marker = jnp.where(valid, jnp.uint64(0),
                                jnp.uint64(0xA5A5A5A5A5A5A5A5))
             any_null = any_null | ~valid
         else:
             marker = jnp.zeros(n, dtype=jnp.uint64)
         h = _mix64(h ^ marker)
-        h = _mix64(h ^ v * jnp.uint64(_HASH_C1))
+        for w in words:
+            h = _mix64(h ^ w * jnp.uint64(_HASH_C1))
     unmatchable = jnp.zeros(n, dtype=bool) if null_safe else any_null
     unmatchable = unmatchable | (jnp.arange(n) >= n_valid)
     if excluded is not None:
@@ -648,6 +653,53 @@ def semi_join_mask(left_keys, right_keys, negate: bool = False,
     matched = jnp.zeros(plen_l, dtype=bool).at[l_idx].set(True, mode="drop")
     out = ~matched if negate else matched
     return out & live_mask(plen_l, n_left)
+
+
+_PK_SENTINEL = jnp.iinfo(jnp.int64).max
+
+
+@jax.jit
+def _pk_gather_impl(fkey, fvalid, dkey, dvalid, n_fact, n_dim,
+                    f_excl, d_excl):
+    """Exact merge-probe of fact keys against a UNIQUE dimension key.
+
+    Dead dimension rows (pads, filtered, null keys) take an unmatchable
+    sentinel before the sort, so one searchsorted + equality check finds the
+    unique match — no hash, no collision verify, no host sync. Returns
+    ``(r_idx, matched)`` at fact physical length.
+    """
+    plen_d = dkey.shape[0]
+    ok_d = jnp.arange(plen_d) < n_dim
+    if dvalid is not None:
+        ok_d = ok_d & dvalid
+    if d_excl is not None:
+        ok_d = ok_d & ~d_excl
+    dk = jnp.where(ok_d, dkey.astype(jnp.int64), _PK_SENTINEL)
+    order = jnp.argsort(dk)
+    dks = jnp.take(dk, order)
+    fk = fkey.astype(jnp.int64)
+    lo = jnp.clip(jnp.searchsorted(dks, fk), 0, plen_d - 1)
+    hit = jnp.take(dks, lo) == fk
+    plen_f = fkey.shape[0]
+    ok_f = jnp.arange(plen_f) < n_fact
+    if fvalid is not None:
+        ok_f = ok_f & fvalid
+    if f_excl is not None:
+        ok_f = ok_f & ~f_excl
+    matched = hit & ok_f & (fk != _PK_SENTINEL)
+    return jnp.take(order, lo), matched
+
+
+def pk_gather_join(fact_key: Column, dim_key: Column,
+                   n_fact: int, n_dim: int, f_excl=None, d_excl=None):
+    """Planner-facing wrapper of :func:`_pk_gather_impl`: prepares
+    comparable integer views (merged dictionary ranks for string pairs)."""
+    if fact_key.kind == "str" and dim_key.kind == "str":
+        fview, dview = ordered_codes_merged(fact_key, dim_key)
+    else:
+        fview, dview = fact_key.data, dim_key.data
+    return _pk_gather_impl(fview, fact_key.valid, dview, dim_key.valid,
+                           n_fact, n_dim, f_excl, d_excl)
 
 
 def _null_column_like(col: Column, n: int) -> Column:

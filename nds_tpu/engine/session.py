@@ -57,6 +57,7 @@ class Session:
         enable_compile_cache()   # backend is resolved by session time
         self.conf = dict(conf or {})
         self.catalog: dict[str, DeviceTable] = {}
+        self.base_tables: set[str] = set()   # names loaded as pristine scans
         self.warehouse = None            # attached by maintenance driver
         self.view_setup_times: list = [] # (name, ms) like setup_tables timing
         # the role Spark's applicationId plays in time logs
@@ -112,10 +113,19 @@ class Session:
                 valid=None if c.valid is None else jax.device_put(c.valid, sh))
         return DeviceTable(cols, table.nrows, plen=table.plen)
 
-    def create_temp_view(self, name: str, table) -> None:
+    def create_temp_view(self, name: str, table, base: bool = False) -> None:
+        """Register a table. ``base=True`` marks a pristine base-table load
+        (raw/columnar/warehouse readers), which lets the planner trust
+        schema facts like primary-key uniqueness; any re-registration under
+        the same name through a non-base path revokes the marker."""
         if isinstance(table, pa.Table):
             table = from_arrow(table)
-        self.catalog[name.lower()] = self._shard_table(table)
+        key = name.lower()
+        self.catalog[key] = self._shard_table(table)
+        if base:
+            self.base_tables.add(key)
+        else:
+            self.base_tables.discard(key)
 
     def read_raw_view(self, name: str, path: str, fields) -> float:
         """Register a raw '|'-delimited table; returns elapsed seconds (the
@@ -125,7 +135,7 @@ class Session:
         start = time.perf_counter()
         arrow = read_raw_table(path, fields)
         canonical = {f.name: f.type for f in fields}
-        self.create_temp_view(name, from_arrow(arrow, canonical))
+        self.create_temp_view(name, from_arrow(arrow, canonical), base=True)
         return time.perf_counter() - start
 
     def read_columnar_view(self, name: str, path: str, fmt: str = "parquet",
@@ -133,14 +143,15 @@ class Session:
         from nds_tpu.io import read_table
         start = time.perf_counter()
         arrow = read_table(path, fmt)
-        self.create_temp_view(name, from_arrow(arrow, canonical_types))
+        self.create_temp_view(name, from_arrow(arrow, canonical_types),
+                              base=True)
         return time.perf_counter() - start
 
     # -- SQL ----------------------------------------------------------------
 
     def sql(self, text: str) -> Result:
         stmt = parse(text)
-        planner = Planner(self.catalog)
+        planner = Planner(self.catalog, base_tables=self.base_tables)
         if isinstance(stmt, A.Query):
             return Result(planner.query(stmt))
         if isinstance(stmt, A.CreateTempView):

@@ -154,7 +154,8 @@ def run_query_stream(input_prefix: str,
         session.warehouse = wh
         for table_name in wh.tables():
             start = time.time()
-            session.create_temp_view(table_name, wh.read(table_name))
+            session.create_temp_view(table_name, wh.read(table_name),
+                                     base=True)
             execution_time_list.append(
                 (session.app_id, f"CreateTempView {table_name}",
                  int((time.time() - start) * 1000)))

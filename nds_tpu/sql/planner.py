@@ -57,8 +57,11 @@ def _nrows(ctx: EvalCtx) -> int:
 
 
 class Planner:
-    def __init__(self, catalog: dict):
+    def __init__(self, catalog: dict, base_tables: set | None = None):
         self.catalog = catalog          # name -> (DeviceTable with plain col names)
+        # names the session loaded as pristine base-table scans; only these
+        # carry schema guarantees (PK uniqueness for gather joins)
+        self.base_tables = base_tables if base_tables is not None else set()
         self.cte_stack: list[dict] = []
 
     # ------------------------------------------------------------------ query
@@ -182,31 +185,40 @@ class Planner:
         if from_ is None:
             # SELECT without FROM: single virtual row
             return DeviceTable({}, 1, plen=E.bucket_len(1))
-        parts, join_preds = self._flatten_from(from_)
-        return self._join_parts(parts, join_preds, [])
+        parts, join_preds, sources = self._flatten_from(from_)
+        return self._join_parts(parts, join_preds, [], sources)
 
     def _flatten_from(self, from_):
-        """Flatten a FROM tree into leaf tables + explicit-join predicates.
-        Non-cross joins keep their structure (executed pairwise); cross/comma
-        joins flatten into the list for WHERE-driven join ordering."""
+        """Flatten a FROM tree into (leaf tables, explicit-join predicates,
+        per-leaf catalog source names). Non-cross joins keep their structure
+        (executed pairwise); cross/comma joins flatten into the list for
+        WHERE-driven join ordering. ``sources[i]`` names the catalog table a
+        leaf scans (None for subqueries/materialized joins) — the provenance
+        the PK gather-join optimization keys on."""
         if isinstance(from_, A.TableRef):
             alias = from_.alias or from_.name
-            return [self._alias_table(self._lookup_table(from_.name), alias)], []
+            name_l = from_.name.lower()
+            # a CTE or temp view shadowing a catalog name is NOT the base
+            # table — its rows carry no schema uniqueness guarantees
+            in_cte = any(name_l in scope for scope in self.cte_stack)
+            is_base = not in_cte and name_l in self.base_tables
+            return ([self._alias_table(self._lookup_table(from_.name), alias)],
+                    [], [name_l if is_base else None])
         if isinstance(from_, A.SubqueryRef):
             t = self.query(from_.query)
-            return [self._alias_table(t, from_.alias)], []
+            return [self._alias_table(t, from_.alias)], [], [None]
         if isinstance(from_, A.Join):
             if from_.kind == "cross":
-                lp, lj = self._flatten_from(from_.left)
-                rp, rj = self._flatten_from(from_.right)
-                return lp + rp, lj + rj
+                lp, lj, ls = self._flatten_from(from_.left)
+                rp, rj, rs = self._flatten_from(from_.right)
+                return lp + rp, lj + rj, ls + rs
             # structured join: materialize it now
-            lp, lj = self._flatten_from(from_.left)
-            left = self._join_parts(lp, lj, [])
-            rp, rj = self._flatten_from(from_.right)
-            right = self._join_parts(rp, rj, [])
+            lp, lj, ls = self._flatten_from(from_.left)
+            left = self._join_parts(lp, lj, [], ls)
+            rp, rj, rs = self._flatten_from(from_.right)
+            right = self._join_parts(rp, rj, [], rs)
             joined = self._binary_join(left, right, from_.kind, from_.condition)
-            return [joined], []
+            return [joined], [], [None]
         raise ExecError(f"unsupported FROM clause {type(from_).__name__}")
 
     # -------------------------------------------------------- join machinery
@@ -384,6 +396,34 @@ class Planner:
                 out_parts.append(DeviceTable(cols, n_rx))
         return E.concat_tables(out_parts) if len(out_parts) > 1 else out_parts[0]
 
+    def _pk_gather_plan(self, tables, sources, a, b, es):
+        """Eligibility of the (a, b) edge batch for a PK gather join.
+
+        Requires a single equi edge whose dimension side is still a pristine
+        base-table scan (``sources`` survives deferred filters and earlier
+        gather joins, which never change a slot's physical rows) joining on
+        its declared single-column primary key — uniqueness is a schema
+        fact, so no runtime check or sync is needed. Returns
+        ``(fact_slot, dim_slot, fact_key, dim_key)`` or None."""
+        from nds_tpu.schema import PRIMARY_KEYS
+        if len(es) != 1 or os.environ.get("NDS_TPU_NO_PK_GATHER"):
+            return None
+        (sl, sr, lk, rk) = es[0]
+        ak, bk = (lk, rk) if sl == a else (rk, lk)
+        for fact_slot, dim_slot, fk, dk in ((a, b, ak, bk), (b, a, bk, ak)):
+            src = sources[dim_slot]
+            pk = PRIMARY_KEYS.get(src) if src else None
+            if pk is None or dk.split(".")[-1] != pk:
+                continue
+            fkc = tables[fact_slot][fk]
+            dkc = tables[dim_slot][dk]
+            if fkc.kind == "f64" or dkc.kind == "f64":
+                continue                      # int/date/str surrogate keys only
+            if (fkc.kind == "str") != (dkc.kind == "str"):
+                continue
+            return fact_slot, dim_slot, fk, dk
+        return None
+
     def _equi_pair(self, c, lcols, rcols):
         if isinstance(c, A.BinaryOp) and c.op == "=" and \
                 isinstance(c.left, A.ColumnRef) and isinstance(c.right, A.ColumnRef):
@@ -496,10 +536,17 @@ class Planner:
             return table
         return E.compact_table(table, self._conjunct_mask(table, conjuncts))
 
-    def _join_parts(self, parts, join_preds, where_conjuncts):
+    def _join_parts(self, parts, join_preds, where_conjuncts, sources=None):
         """Join-graph execution: push single-table predicates down, then join
         parts connected by equi edges, deferring unconnected parts
-        (cartesian only as a last resort)."""
+        (cartesian only as a last resort). ``sources`` carries each part's
+        catalog table name (None otherwise) so single-key joins against a
+        declared dimension primary key run as exact merge-probe gathers
+        with a deferred miss-mask — no host sync, no pair expansion — the
+        star-join shape that dominates the TPC-DS corpus."""
+        if sources is None:
+            sources = [None] * len(parts)
+        sources = list(sources)
         conjuncts = list(join_preds) + list(where_conjuncts)
         # split into single-table filters / equi edges / complex residual
         all_cols = set()
@@ -583,11 +630,29 @@ class Planner:
             if not by_slots:
                 break
             (a, b), es = next(iter(by_slots.items()))
-            l_on = [lk if sl == a else rk for (sl, sr, lk, rk) in es]
-            r_on = [rk if sl == a else lk for (sl, sr, lk, rk) in es]
-            tables[a] = E.join_tables(tables[a], tables[b], l_on, r_on, "inner",
-                                      l_excl=masks[a], r_excl=masks[b])
-            masks[a] = masks[b] = None       # consumed by the join
+            gather = self._pk_gather_plan(tables, sources, a, b, es)
+            if gather is not None:
+                fact_slot, dim_slot, fk_name, dk_name = gather
+                fact_t, dim_t = tables[fact_slot], tables[dim_slot]
+                r_idx, matched = E.pk_gather_join(
+                    fact_t[fk_name], dim_t[dk_name],
+                    fact_t.nrows, dim_t.nrows,
+                    f_excl=masks[fact_slot], d_excl=masks[dim_slot])
+                cols = dict(fact_t.columns)
+                for nm, c in dim_t.columns.items():
+                    cols[nm] = c.take(r_idx)
+                tables[a] = DeviceTable(cols, fact_t.nrows, plen=fact_t.plen)
+                masks[a] = ~matched          # accumulates misses + old masks
+                masks[b] = None
+                sources[a] = sources[fact_slot]   # fact physical survives
+            else:
+                l_on = [lk if sl == a else rk for (sl, sr, lk, rk) in es]
+                r_on = [rk if sl == a else lk for (sl, sr, lk, rk) in es]
+                tables[a] = E.join_tables(tables[a], tables[b], l_on, r_on,
+                                          "inner",
+                                          l_excl=masks[a], r_excl=masks[b])
+                masks[a] = masks[b] = None   # consumed by the join
+                sources[a] = None            # physical rows are pair-expanded
             groups[b] = a
             pending = [e for e in pending if slot(e[0]) != slot(e[1])]
         # cartesian any remaining disconnected slots (materialize any
@@ -607,14 +672,16 @@ class Planner:
     # ---------------------------------------------------------------- SELECT
 
     def select(self, sel: A.Select) -> DeviceTable:
-        parts, join_preds = ([], []) if sel.from_ is None else self._flatten_from(sel.from_)
+        parts, join_preds, sources = (([], [], []) if sel.from_ is None
+                                      else self._flatten_from(sel.from_))
         where_conjuncts = [h for c in self._split_conjuncts(sel.where)
                            for h in self._hoist_or_conjuncts(c)]
         if sel.from_ is None:
             table = DeviceTable({}, 1, plen=E.bucket_len(1))
             table = self._filter_conjuncts(table, where_conjuncts)
         else:
-            table = self._join_parts(parts, join_preds, where_conjuncts)
+            table = self._join_parts(parts, join_preds, where_conjuncts,
+                                     sources)
 
         agg_calls = {}
         self._collect_aggs(
@@ -1316,9 +1383,9 @@ class Planner:
             if sel.group_by or sel.having:
                 raise ExecError("correlated EXISTS with residual predicate "
                                 "and grouping unsupported")
-            parts, preds = self._flatten_from(sel.from_)
+            parts, preds, srcs = self._flatten_from(sel.from_)
             inner_t = self._join_parts(parts, preds,
-                                       self._split_conjuncts(sel.where))
+                                       self._split_conjuncts(sel.where), srcs)
             lkeys = [self.eval_expr(outer, ctx) for outer, _ in corr]
             rkeys = [self.eval_expr(inner, EvalCtx(inner_t))
                      for _, inner in corr]
