@@ -395,3 +395,29 @@ def test_distinct_agg_over_empty_input():
     out = s.sql("select k, count(distinct v), sum(distinct v), "
                 "avg(distinct v) from t where v > 100 group by k")
     assert out.collect() == []
+
+
+def test_pk_gather_respects_shadowed_dimension():
+    """A temp view shadowing a dimension name has no PK guarantee: joins
+    against it must pair-expand duplicates, not gather one arbitrary match."""
+    import pyarrow as pa
+    from nds_tpu.engine.session import Session
+    s = Session()
+    # pristine base dimension (marked base) with unique PK
+    item = pa.table({"i_item_sk": pa.array([1, 2, 3], pa.int64()),
+                     "i_brand": pa.array(["a", "b", "c"])})
+    from nds_tpu.engine.column import from_arrow
+    s.create_temp_view("item", from_arrow(item), base=True)
+    s.create_temp_view("sales", pa.table(
+        {"ss_item_sk": pa.array([1, 2, 2, 9], pa.int64()),
+         "ss_qty": pa.array([10, 20, 30, 40], pa.int64())}))
+    r1 = s.sql("select i_brand, sum(ss_qty) q from sales, item "
+               "where ss_item_sk = i_item_sk group by i_brand order by i_brand")
+    assert r1.collect() == [("a", 10), ("b", 50)]
+    # shadow the dimension with DUPLICATE keys: the marker must be revoked
+    # and the join must produce one row per duplicate match
+    s.sql("create temp view item as "
+          "select * from item union all select * from item")
+    r2 = s.sql("select sum(ss_qty) q from sales, item "
+               "where ss_item_sk = i_item_sk")
+    assert r2.collect() == [(120,)]     # (10 + 20 + 30) doubled
