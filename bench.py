@@ -170,7 +170,9 @@ def run_parent():
         out = tempfile.NamedTemporaryFile(suffix=".json", delete=False).name
         cmd = [sys.executable, os.path.abspath(__file__), "--child",
                "--queries", ",".join(chunk), "--out", out]
-        timeout = min(left, PER_QUERY_TIMEOUT_S * len(chunk))
+        # one wedged chunk must never eat the whole budget (larger chunks
+        # would otherwise raise the per-chunk cap past it)
+        timeout = min(left, PER_QUERY_TIMEOUT_S * len(chunk), budget_s / 2)
         try:
             subprocess.run(cmd, timeout=timeout, check=True)
         except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:

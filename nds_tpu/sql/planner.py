@@ -63,12 +63,49 @@ class Planner:
         # carry schema guarantees (PK uniqueness for gather joins)
         self.base_tables = base_tables if base_tables is not None else set()
         self.cte_stack: list[dict] = []
+        # bare column names the current statement references anywhere
+        # (projection pushdown); None = pruning disabled (SELECT * present
+        # or not yet computed)
+        self._needed_names: set | None = None
 
     # ------------------------------------------------------------------ query
+
+    def _collect_needed_names(self, node) -> set | None:
+        """Bare (unqualified, lowercased) column names referenced anywhere in
+        the statement, or None when a SELECT * makes pruning unsafe. Over-
+        approximates across subqueries — pruning only ever drops columns NO
+        expression in the whole statement mentions, and a miss fails loudly
+        at name resolution, never silently."""
+        names: set = set()
+        star = False
+
+        def walk(x):
+            nonlocal star
+            if star or x is None:
+                return
+            if isinstance(x, A.Star):
+                star = True
+                return
+            if isinstance(x, A.ColumnRef):
+                names.add(x.name.lower())
+            if hasattr(x, "__dataclass_fields__"):
+                for f in vars(x).values():
+                    walk_any(f)
+
+        def walk_any(f):
+            if isinstance(f, (list, tuple)):
+                for y in f:
+                    walk_any(y)
+            elif hasattr(f, "__dataclass_fields__"):
+                walk(f)
+        walk(node)
+        return None if star else names
 
     def query(self, q: A.Query) -> DeviceTable:
         """Execute a full query; returns a DeviceTable whose column names are
         the output names in order."""
+        if self._needed_names is None and not self.cte_stack:
+            self._needed_names = self._collect_needed_names(q)
         scope = {}
         self.cte_stack.append(scope)
         try:
@@ -202,8 +239,16 @@ class Planner:
             # table — its rows carry no schema uniqueness guarantees
             in_cte = any(name_l in scope for scope in self.cte_stack)
             is_base = not in_cte and name_l in self.base_tables
-            return ([self._alias_table(self._lookup_table(from_.name), alias)],
-                    [], [name_l if is_base else None])
+            t = self._alias_table(self._lookup_table(from_.name), alias)
+            if self._needed_names is not None:
+                # projection pushdown: drop scan columns nothing in the
+                # statement references (fact tables are 20+ columns wide,
+                # queries touch a handful)
+                keep = {n for n in t.columns
+                        if n.split(".")[-1] in self._needed_names}
+                if keep and len(keep) < len(t.columns):
+                    t = t.select([n for n in t.column_names if n in keep])
+            return [t], [], [name_l if is_base else None]
         if isinstance(from_, A.SubqueryRef):
             t = self.query(from_.query)
             return [self._alias_table(t, from_.alias)], [], [None]
@@ -262,6 +307,25 @@ class Planner:
             rests.append(self._fold_bool("and", rest))
         return common + [self._fold_bool("or", rests)]
 
+    @staticmethod
+    def _child_exprs(node):
+        """Direct A.Expr children of an AST node (the shared recursion step
+        of every expression walker: dataclass fields that are expressions,
+        lists of expressions, or lists of tuples containing expressions)."""
+        if not hasattr(node, "__dataclass_fields__"):
+            return
+        for f in vars(node).values():
+            if isinstance(f, A.Expr):
+                yield f
+            elif isinstance(f, list):
+                for x in f:
+                    if isinstance(x, A.Expr):
+                        yield x
+                    elif isinstance(x, tuple):
+                        for y in x:
+                            if isinstance(y, A.Expr):
+                                yield y
+
     def _expr_tables(self, e, available: set) -> set:
         """Set of alias-qualified table names an expression references."""
         out = set()
@@ -271,17 +335,8 @@ class Planner:
                 key = self._resolve_name(node, available)
                 if key is not None:
                     out.add(key.split(".")[0])
-            for f in vars(node).values() if hasattr(node, "__dataclass_fields__") else []:
-                if isinstance(f, A.Expr):
-                    walk(f)
-                elif isinstance(f, list):
-                    for x in f:
-                        if isinstance(x, A.Expr):
-                            walk(x)
-                        elif isinstance(x, tuple):
-                            for y in x:
-                                if isinstance(y, A.Expr):
-                                    walk(y)
+            for c in self._child_exprs(node):
+                walk(c)
         walk(e)
         return out
 
@@ -449,18 +504,8 @@ class Planner:
                     isinstance(getattr(node, "query"), A.Query):
                 found = True
                 return
-            if hasattr(node, "__dataclass_fields__"):
-                for f in vars(node).values():
-                    if isinstance(f, A.Expr):
-                        walk(f)
-                    elif isinstance(f, list):
-                        for x in f:
-                            if isinstance(x, A.Expr):
-                                walk(x)
-                            elif isinstance(x, tuple):
-                                for y in x:
-                                    if isinstance(y, A.Expr):
-                                        walk(y)
+            for c in self._child_exprs(node):
+                walk(c)
         walk(e)
         return found
 
@@ -470,18 +515,8 @@ class Planner:
         def walk(node):
             if isinstance(node, A.ColumnRef):
                 out.append(node)
-            if hasattr(node, "__dataclass_fields__"):
-                for f in vars(node).values():
-                    if isinstance(f, A.Expr):
-                        walk(f)
-                    elif isinstance(f, list):
-                        for x in f:
-                            if isinstance(x, A.Expr):
-                                walk(x)
-                            elif isinstance(x, tuple):
-                                for y in x:
-                                    if isinstance(y, A.Expr):
-                                        walk(y)
+            for c in self._child_exprs(node):
+                walk(c)
         walk(e)
         return out
 
@@ -746,18 +781,8 @@ class Planner:
             if isinstance(e, A.FuncCall) and e.name in AGG_FUNCS:
                 out[expr_key(e)] = e
                 return  # no nested aggs
-            if hasattr(e, "__dataclass_fields__"):
-                for f in vars(e).values():
-                    if isinstance(f, A.Expr):
-                        walk(f)
-                    elif isinstance(f, list):
-                        for x in f:
-                            if isinstance(x, A.Expr):
-                                walk(x)
-                            elif isinstance(x, tuple):
-                                for y in x:
-                                    if isinstance(y, A.Expr):
-                                        walk(y)
+            for c in self._child_exprs(e):
+                walk(c)
         for e in exprs:
             if e is not None:
                 walk(e)
@@ -929,18 +954,8 @@ class Planner:
             if isinstance(e, A.WindowFunc):
                 wins.append(e)
                 return
-            if hasattr(e, "__dataclass_fields__"):
-                for f in vars(e).values():
-                    if isinstance(f, A.Expr):
-                        walk(f)
-                    elif isinstance(f, list):
-                        for x in f:
-                            if isinstance(x, A.Expr):
-                                walk(x)
-                            elif isinstance(x, tuple):
-                                for y in x:
-                                    if isinstance(y, A.Expr):
-                                        walk(y)
+            for c in self._child_exprs(e):
+                walk(c)
         for it in sel.items:
             walk(it.expr)
         if sel.having is not None:

@@ -421,3 +421,30 @@ def test_pk_gather_respects_shadowed_dimension():
     r2 = s.sql("select sum(ss_qty) q from sales, item "
                "where ss_item_sk = i_item_sk")
     assert r2.collect() == [(120,)]     # (10 + 20 + 30) doubled
+
+
+def test_projection_pushdown_shapes():
+    """Pruned wide scans must still satisfy aliases, qualified self-joins,
+    correlated subqueries, and SELECT * (which disables pruning)."""
+    import pyarrow as pa
+    from nds_tpu.engine.session import Session
+    s = Session()
+    wide = pa.table({
+        "k": pa.array([1, 2, 3, 4], pa.int64()),
+        "v": pa.array([10, 20, 30, 40], pa.int64()),
+        "w": pa.array([1, 1, 2, 2], pa.int64()),
+        # columns nothing below references — candidates for pruning
+        **{f"pad{i}": pa.array([0, 0, 0, 0], pa.int64()) for i in range(6)},
+    })
+    s.create_temp_view("wide", wide)
+    # alias in ORDER BY over a pruned scan
+    assert s.sql("select v + 1 as vv from wide where k > 1 order by vv") \
+        .collect() == [(21,), (31,), (41,)]
+    # qualified self-join
+    assert s.sql("select a.v, b.v from wide a, wide b "
+                 "where a.k = b.k and a.k = 2").collect() == [(20, 20)]
+    # correlated subquery over the pruned table
+    assert s.sql("select k from wide o where v > (select avg(v) from wide i "
+                 "where i.w = o.w) order by k").collect() == [(2,), (4,)]
+    # SELECT * disables pruning: all 9 columns come back
+    assert s.sql("select * from wide where k = 1").to_arrow().num_columns == 9
