@@ -136,24 +136,35 @@ def run_child(names, out_path):
     json.dump(times, open(out_path, "w"))
 
 
-def resolve_baseline(baseline_file, geomean, n_measured, n_total):
-    """vs_baseline policy: compare only same-sized query sets; (re)baseline
-    only on FULL runs so a partial run (wedged chunk / budget cut) never
-    clobbers the longitudinal baseline, while a legitimately grown query
-    ratchet re-baselines."""
+def _geomean(vals):
+    return math.exp(sum(math.log(max(v, 1e-3)) for v in vals) / len(vals))
+
+
+def resolve_baseline(baseline_file, times, n_total):
+    """vs_baseline policy: the baseline stores per-query times, so a partial
+    run (wedged chunk / budget cut) still compares geomeans over the common
+    query set; only FULL runs may (re)write the baseline, and only when none
+    exists for the current query ratchet size."""
     base = None
     if os.path.exists(baseline_file):
         try:
             base = json.load(open(baseline_file))
         except ValueError:
             base = None
-    if base and base.get("n_queries") == n_measured and base.get("value"):
-        return base["value"] / geomean
-    if n_measured == n_total and (not base or
-                                  base.get("n_queries") != n_measured):
-        json.dump({"metric": "power_geomean_ms", "value": geomean,
-                   "n_queries": n_measured}, open(baseline_file, "w"))
-    return 1.0
+    base_times = (base or {}).get("times") or {}
+    common = sorted(set(times) & set(base_times))
+    vs = (_geomean([base_times[q] for q in common]) /
+          _geomean([times[q] for q in common])) if common else 1.0
+    if len(times) == n_total and (not base or not base_times or
+                                  base.get("n_queries") != n_total):
+        # (re)write on full runs when no baseline exists for this ratchet
+        # size OR the file predates the per-query format (legacy 'value'
+        # only) — otherwise vs_baseline would stay 1.0 forever
+        json.dump({"metric": "power_geomean_ms",
+                   "value": _geomean(list(times.values())),
+                   "n_queries": n_total, "times": times},
+                  open(baseline_file, "w"))
+    return vs
 
 
 def run_parent():
@@ -192,11 +203,10 @@ def run_parent():
         print(f"# measured {len(times)}/{len(names)} queries",
               file=sys.stderr)
 
-    geomean = math.exp(sum(math.log(max(t, 1e-3)) for t in times.values())
-                       / len(times))
+    geomean = _geomean(list(times.values()))
 
     vs = resolve_baseline(os.path.join(REPO, ".bench_baseline.json"),
-                          geomean, len(times), len(names))
+                          times, len(names))
 
     print(json.dumps({
         "metric": "power_geomean_ms",

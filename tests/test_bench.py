@@ -13,33 +13,53 @@ bench = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(bench)
 
 
+def _times(ms, n, start=0):
+    return {f"query{i}": float(ms) for i in range(start, start + n)}
+
+
 class TestResolveBaseline:
     def test_first_full_run_writes_baseline(self, tmp_path):
         f = tmp_path / "base.json"
-        vs = bench.resolve_baseline(str(f), 100.0, 99, 99)
+        vs = bench.resolve_baseline(str(f), _times(100, 99), 99)
         assert vs == 1.0
         assert json.load(open(f))["n_queries"] == 99
 
     def test_same_set_compares(self, tmp_path):
         f = tmp_path / "base.json"
-        bench.resolve_baseline(str(f), 100.0, 99, 99)
-        vs = bench.resolve_baseline(str(f), 50.0, 99, 99)
-        assert vs == 2.0                       # 2x faster than baseline
+        bench.resolve_baseline(str(f), _times(100, 99), 99)
+        vs = bench.resolve_baseline(str(f), _times(50, 99), 99)
+        assert abs(vs - 2.0) < 1e-9            # 2x faster than baseline
 
-    def test_partial_run_never_overwrites(self, tmp_path):
+    def test_partial_run_compares_common_set_without_overwriting(self, tmp_path):
         f = tmp_path / "base.json"
-        bench.resolve_baseline(str(f), 100.0, 99, 99)
-        vs = bench.resolve_baseline(str(f), 10.0, 95, 99)  # wedged chunk
-        assert vs == 1.0                       # not comparable, no clobber
-        assert json.load(open(f))["value"] == 100.0
-        assert bench.resolve_baseline(str(f), 100.0, 99, 99) == 1.0
+        bench.resolve_baseline(str(f), _times(100, 99), 99)
+        vs = bench.resolve_baseline(str(f), _times(10, 95), 99)  # wedged chunk
+        assert abs(vs - 10.0) < 1e-9   # geomean over the 95 common queries
+        assert abs(json.load(open(f))["value"] - 100.0) < 1e-6   # no clobber
+        assert abs(bench.resolve_baseline(str(f), _times(100, 99), 99)
+                   - 1.0) < 1e-9
+
+    def test_disjoint_partial_is_neutral(self, tmp_path):
+        f = tmp_path / "base.json"
+        bench.resolve_baseline(str(f), _times(100, 50), 50)
+        vs = bench.resolve_baseline(str(f), _times(10, 5, start=90), 99)
+        assert vs == 1.0                       # nothing comparable
 
     def test_ratchet_growth_rebaselines(self, tmp_path):
         f = tmp_path / "base.json"
-        bench.resolve_baseline(str(f), 100.0, 80, 80)
-        vs = bench.resolve_baseline(str(f), 120.0, 99, 99)  # set grew
-        assert vs == 1.0
+        bench.resolve_baseline(str(f), _times(100, 80), 80)
+        vs = bench.resolve_baseline(str(f), _times(120, 99), 99)  # set grew
+        assert abs(vs - 100.0 / 120.0) < 1e-9  # compared over 80 common
         assert json.load(open(f))["n_queries"] == 99
+
+    def test_legacy_value_only_baseline_is_migrated(self, tmp_path):
+        f = tmp_path / "base.json"
+        json.dump({"value": 100.0, "n_queries": 99}, open(f, "w"))
+        vs = bench.resolve_baseline(str(f), _times(50, 99), 99)
+        assert vs == 1.0                      # nothing comparable yet
+        assert json.load(open(f))["times"]    # migrated to per-query format
+        vs2 = bench.resolve_baseline(str(f), _times(25, 99), 99)
+        assert abs(vs2 - 2.0) < 1e-9
 
 
 def test_bench_queries_names_match_stream_names():
