@@ -167,6 +167,25 @@ def resolve_baseline(baseline_file, times, n_total):
     return vs
 
 
+def _run_chunk(chunk, left, budget_s, times):
+    out = tempfile.NamedTemporaryFile(suffix=".json", delete=False).name
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--queries", ",".join(chunk), "--out", out]
+    # one wedged chunk must never eat the whole budget (larger chunks
+    # would otherwise raise the per-chunk cap past it)
+    timeout = min(left, PER_QUERY_TIMEOUT_S * len(chunk), budget_s / 2)
+    try:
+        subprocess.run(cmd, timeout=timeout, check=True)
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+        print(f"# chunk {chunk} aborted: {type(e).__name__}",
+              file=sys.stderr)
+    try:
+        times.update(json.load(open(out)))
+    except (OSError, ValueError):
+        pass
+    os.unlink(out)
+
+
 def run_parent():
     ensure_data()                                    # once, before children
     names = [n for n, _ in bench_queries()]
@@ -178,22 +197,14 @@ def run_parent():
         left = budget_s - (time.perf_counter() - t_start)
         if left <= 0:
             break
-        out = tempfile.NamedTemporaryFile(suffix=".json", delete=False).name
-        cmd = [sys.executable, os.path.abspath(__file__), "--child",
-               "--queries", ",".join(chunk), "--out", out]
-        # one wedged chunk must never eat the whole budget (larger chunks
-        # would otherwise raise the per-chunk cap past it)
-        timeout = min(left, PER_QUERY_TIMEOUT_S * len(chunk), budget_s / 2)
-        try:
-            subprocess.run(cmd, timeout=timeout, check=True)
-        except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
-            print(f"# chunk {chunk} aborted: {type(e).__name__}",
-                  file=sys.stderr)
-        try:
-            times.update(json.load(open(out)))
-        except (OSError, ValueError):
-            pass
-        os.unlink(out)
+        _run_chunk(chunk, left, budget_s, times)
+    # retry queries an aborted chunk dragged down, one per child, so a
+    # single wedged/crashing query costs only itself
+    for name in [n for n in names if n not in times]:
+        left = budget_s - (time.perf_counter() - t_start)
+        if left <= 0:
+            break
+        _run_chunk([name], left, budget_s, times)
 
     if not times:
         print(json.dumps({"metric": "power_geomean_ms", "value": None,

@@ -664,8 +664,15 @@ class Planner:
                 by_slots.setdefault(tuple(sorted((sl, sr))), []).append((sl, sr, lk, rk))
             if not by_slots:
                 break
-            (a, b), es = next(iter(by_slots.items()))
-            gather = self._pk_gather_plan(tables, sources, a, b, es)
+            # order heuristic: take PK gather edges first — they never
+            # pair-expand, and their miss-masks shrink every later hash
+            # join's candidate set (q72-class fact x fact joins explode
+            # when run before the dimension predicates mask the facts)
+            (a, b), es, gather = next(
+                ((pair, pes, plan) for pair, pes in by_slots.items()
+                 if (plan := self._pk_gather_plan(
+                     tables, sources, pair[0], pair[1], pes)) is not None),
+                (*next(iter(by_slots.items())), None))
             if gather is not None:
                 fact_slot, dim_slot, fk_name, dk_name = gather
                 fact_t, dim_t = tables[fact_slot], tables[dim_slot]
