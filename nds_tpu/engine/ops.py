@@ -77,6 +77,30 @@ def compact_table(table: DeviceTable, mask: jnp.ndarray) -> DeviceTable:
     return take_padded(table, compact_indices(m, n), n)
 
 
+@jax.jit
+def _gather_cols_impl(idx, datas, valids):
+    """One fused gather of every column (and validity mask) of a table —
+    a single device dispatch where a per-column loop costs 2 x ncols round
+    trips to a remote attachment."""
+    outs = tuple(jnp.take(d, idx, axis=0, mode="clip") for d in datas)
+    vouts = tuple(None if v is None else jnp.take(v, idx, axis=0, mode="clip")
+                  for v in valids)
+    return outs, vouts
+
+
+def gather_table_rows(table: DeviceTable, idx: jnp.ndarray,
+                      nrows: int) -> DeviceTable:
+    """Fused whole-table row gather (clip mode); logical length ``nrows``."""
+    from dataclasses import replace as _replace
+    names = table.column_names
+    cols = [table.columns[n] for n in names]
+    datas, valids = _gather_cols_impl(
+        idx, tuple(c.data for c in cols), tuple(c.valid for c in cols))
+    out = {n: _replace(c, data=d, valid=v)
+           for n, c, d, v in zip(names, cols, datas, valids)}
+    return DeviceTable(out, nrows, plen=int(idx.shape[0]))
+
+
 def take_padded(table: DeviceTable, idx: jnp.ndarray, nrows: int) -> DeviceTable:
     """Gather rows by (possibly out-of-range padded) ``idx``; logical length
     ``nrows``. The physical length follows ``idx`` (already bucketed by the
@@ -87,8 +111,9 @@ def take_padded(table: DeviceTable, idx: jnp.ndarray, nrows: int) -> DeviceTable
         cols = {n: _null_column_like(c, cap)
                 for n, c in table.columns.items()}
         return DeviceTable(cols, 0, plen=cap)
-    cols = {n: c.take(idx) for n, c in table.columns.items()}
-    return DeviceTable(cols, nrows, plen=cap)
+    if not table.columns:
+        return DeviceTable({}, nrows, plen=cap)
+    return gather_table_rows(table, idx, nrows)
 
 
 # ---------------------------------------------------------------------------
@@ -115,14 +140,16 @@ _rank_cache: dict = {}
 
 
 def _dict_ranks(dict_values) -> tuple:
-    """(code -> lexicographic rank, rank -> code) device maps for one string
+    """(code -> lexicographic rank, rank -> code) maps for one string
     dictionary, cached per dictionary (sorts repeat the same dictionaries
-    every query)."""
+    every query). Cached as HOST arrays: a device array built inside a jit
+    trace is a constant tracer, and caching one leaks it into later eager
+    calls (UnexpectedTracerError)."""
     def compute():
         order = np.argsort(dict_values.astype(str), kind="stable")
         ranks = np.empty(len(order), dtype=np.int64)
         ranks[order] = np.arange(len(order))
-        return jnp.asarray(ranks), jnp.asarray(order.astype(np.int64))
+        return ranks, order.astype(np.int64)
     return _identity_cache(_rank_cache, 512, (dict_values,), compute)
 
 
@@ -557,17 +584,18 @@ _merged_cache: dict = {}
 
 def ordered_codes_merged(a: Column, b: Column):
     """Map two string columns' codes into one shared value ordering, cached
-    per dictionary pair."""
+    per dictionary pair (host arrays — see :func:`_dict_ranks`)."""
     def compute():
         union, inverse = np.unique(
             np.concatenate([a.dict_values.astype(str), b.dict_values.astype(str)]),
             return_inverse=True)
-        a_map = jnp.asarray(inverse[: len(a.dict_values)].astype(np.int64))
-        b_map = jnp.asarray(inverse[len(a.dict_values):].astype(np.int64))
+        a_map = inverse[: len(a.dict_values)].astype(np.int64)
+        b_map = inverse[len(a.dict_values):].astype(np.int64)
         return a_map, b_map
     a_map, b_map = _identity_cache(
         _merged_cache, 256, (a.dict_values, b.dict_values), compute)
-    return jnp.take(a_map, a.data), jnp.take(b_map, b.data)
+    return jnp.take(jnp.asarray(a_map), a.data), \
+        jnp.take(jnp.asarray(b_map), b.data)
 
 
 def join_indices(left_keys, right_keys, how: str = "inner",
@@ -717,18 +745,18 @@ def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
         n_left=left.nrows, n_right=right.nrows,
         l_excl=l_excl, r_excl=r_excl)
     matched = DeviceTable(
-        {**{n: c.take(l_idx) for n, c in left.columns.items()},
-         **{n: c.take(r_idx) for n, c in right.columns.items()}}, n_pairs)
+        {**gather_table_rows(left, l_idx, n_pairs).columns,
+         **gather_table_rows(right, r_idx, n_pairs).columns}, n_pairs)
     parts = [matched]
     if l_extra is not None and n_lx:
-        cols = {n: c.take(l_extra) for n, c in left.columns.items()}
+        cols = dict(gather_table_rows(left, l_extra, n_lx).columns)
         cols.update({n: _null_column_like(c, int(l_extra.shape[0]))
                      for n, c in right.columns.items()})
         parts.append(DeviceTable(cols, n_lx))
     if r_extra is not None and n_rx:
         cols = {n: _null_column_like(c, int(r_extra.shape[0]))
                 for n, c in left.columns.items()}
-        cols.update({n: c.take(r_extra) for n, c in right.columns.items()})
+        cols.update(gather_table_rows(right, r_extra, n_rx).columns)
         parts.append(DeviceTable(cols, n_rx))
     return concat_tables(parts) if len(parts) > 1 else matched
 
@@ -738,23 +766,27 @@ def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
 # ---------------------------------------------------------------------------
 
 
+def _align_str_dicts(cols):
+    """(per-part code arrays, shared dictionary) for string columns whose
+    dictionaries may differ: remap every part's codes into one merged
+    value table (identity fast path when all parts share one dictionary)."""
+    dicts = [c.dict_values for c in cols]
+    if all(d is dicts[0] for d in dicts):
+        return [c.data for c in cols], dicts[0]
+    union, inverse = np.unique(
+        np.concatenate([d.astype(str) for d in dicts]), return_inverse=True)
+    datas, off = [], 0
+    for d, c in zip(dicts, cols):
+        m = jnp.asarray(inverse[off:off + len(d)].astype(np.int32))
+        datas.append(jnp.take(m, c.data))
+        off += len(d)
+    return datas, union.astype(object)
+
+
 def concat_columns(cols) -> Column:
     kind = cols[0].kind
     if kind == "str":
-        dicts = [c.dict_values for c in cols]
-        same = all(d is dicts[0] for d in dicts)
-        if not same:
-            union, inverse = np.unique(
-                np.concatenate([d.astype(str) for d in dicts]), return_inverse=True)
-            maps, off = [], 0
-            for d in dicts:
-                maps.append(jnp.asarray(inverse[off:off + len(d)].astype(np.int32)))
-                off += len(d)
-            datas = [jnp.take(m, c.data) for m, c in zip(maps, cols)]
-            dict_values = union.astype(object)
-        else:
-            datas = [c.data for c in cols]
-            dict_values = dicts[0]
+        datas, dict_values = _align_str_dicts(cols)
         data = jnp.concatenate(datas)
         valid = _concat_valids(cols)
         return Column("str", data.astype(jnp.int32), valid, dict_values)
@@ -768,15 +800,60 @@ def _concat_valids(cols):
     return jnp.concatenate([c.valid_mask() for c in cols])
 
 
+@jax.jit
+def _concat_cols_impl(parts_datas, parts_valids, part_nrows):
+    """Fused concatenation of every column of a UNION ALL (plus the live
+    mask) in one device dispatch. ``parts_valids`` entries are per-column
+    tuples mixing arrays and None (all-valid parts materialize ones only
+    when some sibling carries a mask)."""
+    datas = tuple(jnp.concatenate(ds) for ds in parts_datas)
+    valids = []
+    for ds, vs in zip(parts_datas, parts_valids):
+        if vs is None:
+            valids.append(None)
+        else:
+            valids.append(jnp.concatenate([
+                v if v is not None else jnp.ones(d.shape[0], dtype=bool)
+                for d, v in zip(ds, vs)]))
+    plens = [d.shape[0] for d in parts_datas[0]]
+    live = jnp.concatenate([jnp.arange(p) < n
+                            for p, n in zip(plens, part_nrows)])
+    return datas, tuple(valids), live
+
+
 def concat_tables(tables) -> DeviceTable:
     """UNION ALL. Physical concatenation interleaves each part's pad rows, so
     the result is re-compacted back to prefix-padded form; the logical counts
-    are already known on host, so this costs no sync."""
+    are already known on host, so this costs no sync. All columns concatenate
+    in one fused dispatch (string columns pre-align their dictionaries on
+    host)."""
     names = tables[0].column_names
-    out = {n: concat_columns([t[n] for t in tables]) for n in names}
     total = sum(t.nrows for t in tables)
-    live = jnp.concatenate(
-        [live_mask(t.plen, t.nrows) for t in tables])
+    if not names:
+        return DeviceTable({}, total, plen=max(bucket_len(total), total))
+
+    parts_datas, parts_valids, metas = [], [], []
+    for n in names:
+        cols = [t[n] for t in tables]
+        kind = cols[0].kind
+        if kind == "str":
+            datas, dict_values = _align_str_dicts(cols)
+        else:
+            datas, dict_values = [c.data for c in cols], None
+        vs = None if all(c.valid is None for c in cols) else \
+            tuple(c.valid for c in cols)
+        parts_datas.append(tuple(datas))
+        parts_valids.append(vs)
+        metas.append((n, kind, dict_values))
+
+    part_nrows = tuple(t.nrows for t in tables)
+    datas, valids, live = _concat_cols_impl(
+        tuple(parts_datas), tuple(parts_valids), part_nrows)
+    out = {}
+    for (n, kind, dict_values), d, v in zip(metas, datas, valids):
+        if kind == "str":
+            d = d.astype(jnp.int32)
+        out[n] = Column(kind, d, v, dict_values)
     raw = DeviceTable(out, total)
     if total == int(live.shape[0]):
         return raw                                    # no pads anywhere
@@ -792,8 +869,7 @@ def concat_tables(tables) -> DeviceTable:
 def sort_table(table: DeviceTable, keys, descending=None, nulls_last=None) -> DeviceTable:
     order = lexsort_indices([table[k] if isinstance(k, str) else k for k in keys],
                             descending, nulls_last, n_valid=table.nrows)
-    cols = {n: c.take(order) for n, c in table.columns.items()}
-    return DeviceTable(cols, table.nrows)
+    return gather_table_rows(table, order, table.nrows)
 
 
 def limit_table(table: DeviceTable, n: int) -> DeviceTable:
@@ -802,6 +878,4 @@ def limit_table(table: DeviceTable, n: int) -> DeviceTable:
     cap = bucket_len(new_n)
     if cap >= table.plen:
         return DeviceTable(dict(table.columns), new_n)
-    idx = jnp.arange(cap)
-    cols = {nm: c.take(idx) for nm, c in table.columns.items()}
-    return DeviceTable(cols, new_n)
+    return gather_table_rows(table, jnp.arange(cap), new_n)

@@ -56,12 +56,15 @@ class DeviceTable:
             self.nrows, self.plen)
 
     def take(self, indices, nrows: int | None = None) -> "DeviceTable":
-        """Dense gather: logical length defaults to the index count (exact
-        materialization). Pass ``nrows`` when gathering with a padded index
-        vector or permutation to preserve the logical count."""
-        cols = {n: c.take(indices) for n, c in self.columns.items()}
+        """Row gather (one fused device dispatch for every column): logical
+        length defaults to the index count (exact materialization). Pass
+        ``nrows`` when gathering with a padded index vector or permutation
+        to preserve the logical count."""
+        from nds_tpu.engine.ops import gather_table_rows
         n = int(indices.shape[0]) if nrows is None else nrows
-        return DeviceTable(cols, n)
+        if not self.columns:
+            return DeviceTable({}, n, plen=int(indices.shape[0]))
+        return gather_table_rows(self, indices, n)
 
     def to_arrow(self):
         from nds_tpu.engine.column import to_arrow

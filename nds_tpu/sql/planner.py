@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -38,6 +39,11 @@ class ExecError(ValueError):
 # join's sort/probe width.
 _DEFER_FILTER_MAX_ROWS = int(
     os.environ.get("NDS_TPU_DEFER_FILTER_MAX_ROWS", 1 << 21))
+
+# fused predicate programs: (conjunct expr keys, table signature) ->
+# (dictionary identity refs, jitted callable | None-for-fallback)
+_MASK_FUSE_CACHE: dict = {}
+_MASK_FUSE_MAX = 4096
 
 
 @dataclass
@@ -386,10 +392,10 @@ class Planner:
                 l_idx, r_idx, n_pairs, _, _, _, _ = E.join_indices(
                     lkeys, rkeys, "inner",
                     n_left=left.nrows, n_right=right.nrows)
-                pair_cols = {n: c.take(l_idx) for n, c in left.columns.items()}
-                pair_cols.update(
-                    {n: c.take(r_idx) for n, c in right.columns.items()})
-                pairs = DeviceTable(pair_cols, n_pairs)
+                pairs = DeviceTable(
+                    {**E.gather_table_rows(left, l_idx, n_pairs).columns,
+                     **E.gather_table_rows(right, r_idx, n_pairs).columns},
+                    n_pairs)
                 ok = self._conjunct_mask(pairs, residual)
                 ok = ok & E.live_mask(pairs.plen, pairs.nrows)
                 safe = jnp.where(ok, l_idx, left.plen)
@@ -416,9 +422,9 @@ class Planner:
         # columns, filter by the residual conjuncts, then rebuild outer rows
         l_idx, r_idx, n_pairs, _, _, _, _ = E.join_indices(
             lkeys, rkeys, "inner", n_left=left.nrows, n_right=right.nrows)
-        pair_cols = {n: c.take(l_idx) for n, c in left.columns.items()}
-        pair_cols.update({n: c.take(r_idx) for n, c in right.columns.items()})
-        pairs = DeviceTable(pair_cols, n_pairs)
+        pairs = DeviceTable(
+            {**E.gather_table_rows(left, l_idx, n_pairs).columns,
+             **E.gather_table_rows(right, r_idx, n_pairs).columns}, n_pairs)
         keep_mask = self._conjunct_mask(pairs, residual)
         keep_mask = keep_mask & E.live_mask(pairs.plen, pairs.nrows)
         matched = E.compact_table(pairs, keep_mask)
@@ -554,17 +560,77 @@ class Planner:
         idx = jnp.nonzero(live, size=E.bucket_len(total), fill_value=pl * pr)[0]
         li = jnp.take(li, idx, mode="fill", fill_value=pl)
         ri = jnp.take(ri, idx, mode="fill", fill_value=pr)
-        cols = {n: c.take(li) for n, c in left.columns.items()}
-        cols.update({n: c.take(ri) for n, c in right.columns.items()})
-        return DeviceTable(cols, total)
+        return DeviceTable(
+            {**E.gather_table_rows(left, li, total).columns,
+             **E.gather_table_rows(right, ri, total).columns}, total)
 
-    def _conjunct_mask(self, table: DeviceTable, conjuncts) -> jnp.ndarray:
+    def _conjunct_mask_eager(self, table: DeviceTable, conjuncts) -> jnp.ndarray:
         ctx = EvalCtx(table)
         mask = jnp.ones(table.plen, dtype=bool)
         for c in conjuncts:
             col = self.eval_expr(c, ctx)
             mask = mask & col.data.astype(bool) & col.valid_mask()
         return mask
+
+    def _conjunct_mask(self, table: DeviceTable, conjuncts) -> jnp.ndarray:
+        """Predicate mask over a plain table. Subquery-free conjunct sets
+        evaluate inside ONE jitted program per (expressions, table
+        signature) — a WHERE clause of a dozen predicates costs a single
+        device dispatch instead of one per scalar op, which is the dominant
+        per-query cost on a remote (tunneled) attachment. Expressions whose
+        evaluation needs concrete values on host (calendar interval math,
+        string casts of numeric columns) fail the one trace attempt and the
+        set permanently falls back to eager evaluation."""
+        if not conjuncts:
+            return jnp.ones(table.plen, dtype=bool)
+        if os.environ.get("NDS_TPU_NO_EXPR_FUSE") or \
+                any(self._has_subquery(c) for c in conjuncts):
+            return self._conjunct_mask_eager(table, conjuncts)
+        # key and jit inputs cover only the columns the predicates can
+        # reference — unrelated columns changing shape must not retrace
+        refs = {r.name.lower()
+                for c in conjuncts for r in self._column_refs(c)}
+        names = [n for n in table.column_names if n.split(".")[-1] in refs]
+        if not names:
+            return self._conjunct_mask_eager(table, conjuncts)
+        cols = [table.columns[n] for n in names]
+        plen = table.plen
+        key = (tuple(expr_key(c) for c in conjuncts), plen,
+               tuple((n, c.kind, int(c.data.shape[0]), c.valid is not None)
+                     for n, c in zip(names, cols)))
+        hit = _MASK_FUSE_CACHE.get(key)
+        if hit is not None and all(h is c.dict_values
+                                   for h, c in zip(hit[0], cols)):
+            fn = hit[1]
+            if fn is None:
+                return self._conjunct_mask_eager(table, conjuncts)
+            return fn(tuple(c.data for c in cols),
+                      tuple(c.valid for c in cols))
+        dict_refs = tuple(c.dict_values for c in cols)
+        kinds = tuple(c.kind for c in cols)
+        # a DETACHED planner evaluates inside the trace: capturing self
+        # would pin this query's planner (and its device-resident contexts)
+        # in the module cache for process lifetime
+        ev = Planner({}, base_tables=set())
+
+        def impl(datas, valids):
+            tcols = {n: Column(k, d, v, dv) for n, k, d, v, dv in
+                     zip(names, kinds, datas, valids, dict_refs)}
+            # nrows deliberately = plen: expression evaluation must never
+            # depend on the logical count (pads are masked later)
+            return ev._conjunct_mask_eager(
+                DeviceTable(tcols, plen, plen=plen), conjuncts)
+
+        fn = jax.jit(impl)
+        try:
+            out = fn(tuple(c.data for c in cols), tuple(c.valid for c in cols))
+        except Exception:
+            fn = None
+            out = self._conjunct_mask_eager(table, conjuncts)
+        if len(_MASK_FUSE_CACHE) >= _MASK_FUSE_MAX:
+            _MASK_FUSE_CACHE.pop(next(iter(_MASK_FUSE_CACHE)))
+        _MASK_FUSE_CACHE[key] = (dict_refs, fn)
+        return out
 
     def _filter_conjuncts(self, table: DeviceTable, conjuncts) -> DeviceTable:
         if not conjuncts:
@@ -681,8 +747,8 @@ class Planner:
                     fact_t.nrows, dim_t.nrows,
                     f_excl=masks[fact_slot], d_excl=masks[dim_slot])
                 cols = dict(fact_t.columns)
-                for nm, c in dim_t.columns.items():
-                    cols[nm] = c.take(r_idx)
+                cols.update(E.gather_table_rows(
+                    dim_t, r_idx, fact_t.nrows).columns)
                 tables[a] = DeviceTable(cols, fact_t.nrows, plen=fact_t.plen)
                 masks[a] = ~matched          # accumulates misses + old masks
                 masks[b] = None
@@ -731,13 +797,11 @@ class Planner:
             agg_calls)
         has_group = sel.group_by is not None
         if has_group or agg_calls:
-            out, post_ctx = self._aggregate(sel, table, agg_calls)
+            out, _ = self._aggregate(sel, table, agg_calls)
         else:
             ctx = EvalCtx(table)
             self._eval_windows(sel, ctx)
             out = self._project(sel, ctx)
-            post_ctx = ctx
-        self._last_ctx = post_ctx
         if sel.distinct:
             out = self._distinct(out)
         return out
@@ -1414,10 +1478,11 @@ class Planner:
             l_idx, r_idx, n_pairs, _, _, _, _ = E.join_indices(
                 lkeys, rkeys, "inner",
                 n_left=ctx.table.nrows, n_right=inner_t.nrows)
-            pair_cols = {nm: c.take(r_idx)
-                         for nm, c in inner_t.columns.items()}
-            for nm, c in ctx.table.columns.items():
-                pair_cols.setdefault(nm, c.take(l_idx))
+            pair_cols = dict(E.gather_table_rows(
+                inner_t, r_idx, n_pairs).columns)
+            outer_g = E.gather_table_rows(ctx.table, l_idx, n_pairs).columns
+            for nm, c in outer_g.items():
+                pair_cols.setdefault(nm, c)
             pairs = DeviceTable(pair_cols, n_pairs)
             ok = self._conjunct_mask(pairs, residual)
             ok = ok & E.live_mask(pairs.plen, pairs.nrows)
