@@ -141,10 +141,12 @@ def _geomean(vals):
 
 
 def resolve_baseline(baseline_file, times, n_total):
-    """vs_baseline policy: the baseline stores per-query times, so a partial
-    run (wedged chunk / budget cut) still compares geomeans over the common
-    query set; only FULL runs may (re)write the baseline, and only when none
-    exists for the current query ratchet size."""
+    """vs_baseline policy: the baseline stores each query's FIRST recorded
+    time. Any run fills in queries the baseline lacks (so a partial run
+    seeds, and an OOM-bound outlier joins whenever it first succeeds) but
+    never overwrites an existing entry — the comparison stays longitudinal
+    against the first measurement. vs_baseline is the geomean ratio over
+    the common query set."""
     base = None
     if os.path.exists(baseline_file):
         try:
@@ -155,14 +157,13 @@ def resolve_baseline(baseline_file, times, n_total):
     common = sorted(set(times) & set(base_times))
     vs = (_geomean([base_times[q] for q in common]) /
           _geomean([times[q] for q in common])) if common else 1.0
-    if len(times) == n_total and (not base or not base_times or
-                                  base.get("n_queries") != n_total):
-        # (re)write on full runs when no baseline exists for this ratchet
-        # size OR the file predates the per-query format (legacy 'value'
-        # only) — otherwise vs_baseline would stay 1.0 forever
+    merged = dict(base_times)
+    for q, t in times.items():
+        merged.setdefault(q, t)
+    if merged != base_times:
         json.dump({"metric": "power_geomean_ms",
-                   "value": _geomean(list(times.values())),
-                   "n_queries": n_total, "times": times},
+                   "value": _geomean(list(merged.values())),
+                   "n_queries": len(merged), "times": merged},
                   open(baseline_file, "w"))
     return vs
 

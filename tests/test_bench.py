@@ -39,13 +39,24 @@ class TestResolveBaseline:
         assert abs(bench.resolve_baseline(str(f), _times(100, 99), 99)
                    - 1.0) < 1e-9
 
+    def test_faster_partial_with_more_queries_never_clobbers(self, tmp_path):
+        # a later, slower run that happens to measure MORE queries must not
+        # replace existing first-recorded entries, only fill in new ones
+        f = tmp_path / "base.json"
+        bench.resolve_baseline(str(f), _times(100, 95), 99)
+        bench.resolve_baseline(str(f), _times(200, 96), 99)
+        base = json.load(open(f))["times"]
+        assert len(base) == 96
+        assert base["query0"] == 100.0       # first recording kept
+        assert base["query95"] == 200.0      # gap filled
+
     def test_disjoint_partial_is_neutral(self, tmp_path):
         f = tmp_path / "base.json"
         bench.resolve_baseline(str(f), _times(100, 50), 50)
         vs = bench.resolve_baseline(str(f), _times(10, 5, start=90), 99)
         assert vs == 1.0                       # nothing comparable
 
-    def test_ratchet_growth_rebaselines(self, tmp_path):
+    def test_ratchet_growth_extends_baseline(self, tmp_path):
         f = tmp_path / "base.json"
         bench.resolve_baseline(str(f), _times(100, 80), 80)
         vs = bench.resolve_baseline(str(f), _times(120, 99), 99)  # set grew
@@ -70,3 +81,15 @@ def test_bench_queries_names_match_stream_names():
     # the four split templates surface as _part1/_part2 names
     if len(names) > 1:
         assert "query14_part1" in names and "query14_part2" in names
+
+
+def test_first_partial_run_seeds_baseline(tmp_path):
+    """A query that can never run (OOM-bound outlier) must not block
+    baselining forever: the first run seeds whatever it measured."""
+    f = tmp_path / "base.json"
+    vs = bench.resolve_baseline(str(f), _times(100, 102), 103)
+    assert vs == 1.0
+    assert len(json.load(open(f))["times"]) == 102
+    assert json.load(open(f))["n_queries"] == 102   # what was measured
+    vs2 = bench.resolve_baseline(str(f), _times(50, 102), 103)
+    assert abs(vs2 - 2.0) < 1e-9
