@@ -703,7 +703,10 @@ def _pk_gather_impl(fkey, fvalid, dkey, dvalid, n_fact, n_dim,
     if d_excl is not None:
         ok_d = ok_d & ~d_excl
     dk = jnp.where(ok_d, dkey.astype(jnp.int64), _PK_SENTINEL)
-    order = jnp.argsort(dk)
+    # live-first tie-break: a dead row's sentinel must sort after a live row
+    # holding the same (legitimate) key value, so leftmost searchsorted
+    # always lands on the live row when one exists
+    order = jnp.lexsort((~ok_d, dk))
     dks = jnp.take(dk, order)
     fk = fkey.astype(jnp.int64)
     lo = jnp.clip(jnp.searchsorted(dks, fk), 0, plen_d - 1)
@@ -714,7 +717,11 @@ def _pk_gather_impl(fkey, fvalid, dkey, dvalid, n_fact, n_dim,
         ok_f = ok_f & fvalid
     if f_excl is not None:
         ok_f = ok_f & ~f_excl
-    matched = hit & ok_f & (fk != _PK_SENTINEL)
+    # gate on the matched dim row's liveness rather than on the fact key
+    # value: a legitimate key equal to the sentinel (2^63-1) can only "hit"
+    # a live dim row holding that same real key, so it still matches, while
+    # hits on dead (sentinel-keyed) dim rows are rejected
+    matched = hit & ok_f & jnp.take(jnp.take(ok_d, order), lo)
     return jnp.take(order, lo), matched
 
 
@@ -855,7 +862,10 @@ def concat_tables(tables) -> DeviceTable:
             d = d.astype(jnp.int32)
         out[n] = Column(kind, d, v, dict_values)
     raw = DeviceTable(out, total)
-    if total == int(live.shape[0]):
+    # fast path only when the summed physical length is itself a canonical
+    # bucket: a non-bucket plen (e.g. 16+32=48) would leak into the XLA
+    # shape universe and defeat executable reuse downstream
+    if total == int(live.shape[0]) and total == bucket_len(total):
         return raw                                    # no pads anywhere
     idx = compact_indices(live, total)
     return take_padded(raw, idx, total)

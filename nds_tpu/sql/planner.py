@@ -14,6 +14,7 @@ by unique suffix match, mirroring SQL scoping.
 
 from __future__ import annotations
 
+import logging
 import os
 from dataclasses import dataclass, field
 
@@ -110,7 +111,8 @@ class Planner:
     def query(self, q: A.Query) -> DeviceTable:
         """Execute a full query; returns a DeviceTable whose column names are
         the output names in order."""
-        if self._needed_names is None and not self.cte_stack:
+        top_level = self._needed_names is None and not self.cte_stack
+        if top_level:
             self._needed_names = self._collect_needed_names(q)
         scope = {}
         self.cte_stack.append(scope)
@@ -125,6 +127,10 @@ class Planner:
             return out
         finally:
             self.cte_stack.pop()
+            # a reused Planner must not prune the next statement's scans
+            # with this statement's column set
+            if top_level:
+                self._needed_names = None
 
     def _apply_order_by(self, out: DeviceTable, order_by,
                         body=None) -> DeviceTable:
@@ -624,7 +630,17 @@ class Planner:
         fn = jax.jit(impl)
         try:
             out = fn(tuple(c.data for c in cols), tuple(c.valid for c in cols))
-        except Exception:
+        except (TypeError, ValueError, NotImplementedError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerBoolConversionError) as e:
+            # trace-time failures only: the conjunct set genuinely cannot be
+            # fused, so pin it to eager. Runtime errors (device OOM, wedged
+            # RPC) must propagate — swallowing one would silently pin a
+            # fusable set to eager forever.
+            logging.getLogger(__name__).info(
+                "predicate fusion fell back to eager: %s: %s",
+                type(e).__name__, e)
             fn = None
             out = self._conjunct_mask_eager(table, conjuncts)
         if len(_MASK_FUSE_CACHE) >= _MASK_FUSE_MAX:

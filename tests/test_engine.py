@@ -286,3 +286,24 @@ def test_float_sort_nan_ties_break_on_secondary_key():
     got = out.to_arrow().to_pydict()["y"]
     # 0.5, 1.5 first; the three NaN rows ordered by y
     assert got == [9, 9, 1, 2, 3]
+
+
+def test_pk_gather_sentinel_key_matches_live_dim_row():
+    """A legitimate key of 2^63-1 must match its dimension row even when a
+    dead (null-keyed) dim row shares the sentinel slot at a lower physical
+    index — the live-first tie-break in the merge probe guarantees leftmost
+    searchsorted lands on the live row."""
+    import jax.numpy as jnp
+    from nds_tpu.engine.ops import pk_gather_join
+    from nds_tpu.engine.column import Column
+    big = jnp.iinfo(jnp.int64).max
+    # dim: row0 dead (null key), row1 live with the sentinel-valued key,
+    # rows 2..3 live ordinary keys; physical length 4 = bucket
+    dkey = Column("int", jnp.array([0, big, 5, 7], dtype=jnp.int64),
+                  jnp.array([False, True, True, True]), None)
+    fkey = Column("int", jnp.array([big, 5, 6, 0], dtype=jnp.int64),
+                  None, None)
+    r_idx, matched = pk_gather_join(fkey, dkey, n_fact=4, n_dim=4)
+    assert matched.tolist() == [True, True, False, False]
+    assert int(r_idx[0]) == 1          # the live sentinel-keyed dim row
+    assert int(r_idx[1]) == 2
