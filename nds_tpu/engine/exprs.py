@@ -20,6 +20,7 @@ from nds_tpu.engine.column import Column, is_dec
 from nds_tpu.engine.ops import ordered_codes_merged
 
 _MAX_DEC_SCALE = 10
+_str_literal_dicts: dict = {}
 
 
 # ---------------------------------------------------------------------------
@@ -38,8 +39,15 @@ def literal(value, n: int) -> Column:
     if isinstance(value, float):
         return Column("f64", jnp.full(n, value, dtype=jnp.float64))
     if isinstance(value, str):
-        return Column("str", jnp.zeros(n, dtype=jnp.int32), None,
-                      np.asarray([value], dtype=object))
+        # per-value dictionary cache: identity-keyed caches downstream
+        # (expression fusion) need the same host object on every execution.
+        # Bounded FIFO like the engine's other dictionary caches.
+        d = _str_literal_dicts.get(value)
+        if d is None:
+            if len(_str_literal_dicts) >= 4096:
+                _str_literal_dicts.pop(next(iter(_str_literal_dicts)))
+            d = _str_literal_dicts[value] = np.asarray([value], dtype=object)
+        return Column("str", jnp.zeros(n, dtype=jnp.int32), None, d)
     if type(value).__name__ == "Decimal":
         s = -value.as_tuple().exponent
         s = max(0, s)
@@ -401,12 +409,32 @@ def parse_date_literal(text: str) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _map_dict(col: Column, fn) -> Column:
-    """Apply a str->str function to the dictionary, re-uniquing the result."""
-    new_vals = np.asarray([fn(str(v)) for v in col.dict_values], dtype=object)
-    uniq, inv = np.unique(new_vals.astype(str), return_inverse=True)
-    remap = jnp.asarray(inv.astype(np.int32))
-    return Column("str", jnp.take(remap, col.data), col.valid, uniq.astype(object))
+_map_dict_cache: dict = {}
+
+
+def _map_dict(col: Column, fn, tag=None) -> Column:
+    """Apply a str->str function to the dictionary, re-uniquing the result.
+    ``tag`` (a hashable description of ``fn``) enables caching per input
+    dictionary, so repeated executions return the SAME output dictionary
+    object — identity-keyed caches downstream (expression fusion) depend on
+    stable dictionary identities across runs."""
+    def compute():
+        new_vals = np.asarray([fn(str(v)) for v in col.dict_values],
+                              dtype=object)
+        uniq, inv = np.unique(new_vals.astype(str), return_inverse=True)
+        # cache HOST arrays only: a device constant created inside a jit
+        # trace is a tracer, and caching one leaks it across traces
+        return inv.astype(np.int32), uniq.astype(object)
+
+    if tag is None:
+        remap, uniq = compute()
+    else:
+        from nds_tpu.engine.ops import _identity_cache
+        remap, uniq = _identity_cache(
+            _map_dict_cache.setdefault(tag, {}), 256,
+            (col.dict_values,), compute)
+    return Column("str", jnp.take(jnp.asarray(remap), col.data),
+                  col.valid, uniq)
 
 
 def _dict_predicate(col: Column, fn) -> Column:
@@ -419,19 +447,19 @@ def fn_substr(col: Column, start: int, length: int | None = None) -> Column:
     def f(s):
         i = start - 1 if start > 0 else len(s) + start
         return s[i:i + length] if length is not None else s[i:]
-    return _map_dict(col, f)
+    return _map_dict(col, f, tag=("substr", start, length))
 
 
 def fn_upper(col: Column) -> Column:
-    return _map_dict(col, str.upper)
+    return _map_dict(col, str.upper, tag=("upper",))
 
 
 def fn_lower(col: Column) -> Column:
-    return _map_dict(col, str.lower)
+    return _map_dict(col, str.lower, tag=("lower",))
 
 
 def fn_trim(col: Column) -> Column:
-    return _map_dict(col, str.strip)
+    return _map_dict(col, str.strip, tag=("trim",))
 
 
 def fn_length(col: Column) -> Column:

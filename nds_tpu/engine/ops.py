@@ -369,7 +369,7 @@ def _agg_sum_impl(data, valid, gids, ngroups, as_f64):
 def agg_sum(col: Column, gids, ngroups) -> Column:
     if col.kind == "f64":
         from nds_tpu.engine.kernels import pallas_active, segment_sum_fused
-        if pallas_active():
+        if pallas_active(ngroups):
             # opt-in MXU fast path (f32 accumulation; the exact path below is
             # the default because validation compares at decimal tolerance).
             # The kernel's counts are per-group valid counts (gid -1 = null),
@@ -430,6 +430,19 @@ def agg_avg(col: Column, gids, ngroups) -> Column:
     data = col.data.astype(jnp.float64)
     if is_dec(col.kind):
         data = data / (10.0 ** col.scale)
+    if col.kind == "f64":
+        # avg is exactly the kernel's (sums, counts) pair in one MXU pass;
+        # decimal avgs stay on the exact XLA path like decimal sums
+        from nds_tpu.engine.kernels import pallas_active, segment_sum_fused
+        if pallas_active(ngroups):
+            valid = col.valid_mask()
+            g = jnp.where(valid, gids, -1)
+            sums, counts = segment_sum_fused(
+                jnp.where(valid, data, 0.0), g, ngroups)
+            out = jnp.where(counts > 0,
+                            sums.astype(jnp.float64) /
+                            jnp.maximum(counts.astype(jnp.float64), 1.0), 0.0)
+            return Column("f64", out, counts > 0)
     out, nonempty = _agg_avg_impl(data, col.valid, gids, ngroups)
     return Column("f64", out, nonempty)
 
@@ -598,18 +611,12 @@ def ordered_codes_merged(a: Column, b: Column):
         jnp.take(jnp.asarray(b_map), b.data)
 
 
-def join_indices(left_keys, right_keys, how: str = "inner",
-                 null_safe: bool = False,
-                 n_left: int | None = None, n_right: int | None = None,
-                 l_excl=None, r_excl=None):
-    """Equi-join. Returns ``(l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra,
-    n_rx)``: bucket-padded matched pair indices with their logical count,
-    plus (for outer joins) the bucket-padded unmatched row indices of each
-    side. Pad slots hold out-of-range indices (gathers clip, scatters drop).
-    ``l_excl``/``r_excl`` are deferred filter masks (True = row filtered
-    out): such rows join nothing, which lets the planner push a filter into
-    the join without a compaction sync.
-    """
+def _probe_candidates(left_keys, right_keys, null_safe=False,
+                      n_left=None, n_right=None, l_excl=None, r_excl=None):
+    """Hash-probe phase shared by the monolithic and chunked joins: returns
+    ``(counts, lo, order, total)`` — per-left-row candidate counts, start
+    offsets into the hash-sorted right side, the right-side sort order, and
+    the total candidate-pair count (host sync)."""
     plen_l = len(left_keys[0])
     plen_r = len(right_keys[0])
     n_left = plen_l if n_left is None else n_left
@@ -625,6 +632,29 @@ def join_indices(left_keys, right_keys, how: str = "inner",
     hi = jnp.searchsorted(rh_sorted, lh, side="right")
     counts = hi - lo
     total = int(jnp.sum(counts))                       # host sync 1
+    return counts, lo, order, total
+
+
+def join_indices(left_keys, right_keys, how: str = "inner",
+                 null_safe: bool = False,
+                 n_left: int | None = None, n_right: int | None = None,
+                 l_excl=None, r_excl=None, probe=None):
+    """Equi-join. Returns ``(l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra,
+    n_rx)``: bucket-padded matched pair indices with their logical count,
+    plus (for outer joins) the bucket-padded unmatched row indices of each
+    side. Pad slots hold out-of-range indices (gathers clip, scatters drop).
+    ``l_excl``/``r_excl`` are deferred filter masks (True = row filtered
+    out): such rows join nothing, which lets the planner push a filter into
+    the join without a compaction sync. ``probe`` passes a precomputed
+    :func:`_probe_candidates` result.
+    """
+    plen_l = len(left_keys[0])
+    plen_r = len(right_keys[0])
+    n_left = plen_l if n_left is None else n_left
+    n_right = plen_r if n_right is None else n_right
+    counts, lo, order, total = probe if probe is not None else \
+        _probe_candidates(left_keys, right_keys, null_safe,
+                          n_left, n_right, l_excl, r_excl)
     if total > 0:
         cand = bucket_len(total)
         l_idx = jnp.repeat(jnp.arange(plen_l), counts, total_repeat_length=cand)
@@ -742,18 +772,115 @@ def _null_column_like(col: Column, n: int) -> Column:
     return Column(col.kind, data, jnp.zeros(n, dtype=bool), col.dict_values)
 
 
+# candidate-pair budget for one materialized join chunk: beyond this the
+# inner join splits the probe side into capacity-bounded chunks (the >HBM
+# streaming answer SURVEY §5.7 calls for; the reference's analog is the
+# RAPIDS spill store + spark.sql.shuffle.partitions,
+# ref: nds/power_run_gpu.template:29-37)
+_PAIR_BUDGET = int(os.environ.get("NDS_TPU_PAIR_BUDGET", str(1 << 22)))
+
+
+@functools.partial(jax.jit, static_argnames=("cand",))
+def _span_pair_indices(counts, lo, order, s, e, cand):
+    """Candidate pair indices restricted to probe rows [s, e); padded to the
+    static capacity ``cand`` (span boundaries are dynamic, so every span
+    with the same capacity reuses one executable)."""
+    plen_l = counts.shape[0]
+    plen_r = order.shape[0]
+    row = jnp.arange(plen_l)
+    c_counts = jnp.where((row >= s) & (row < e), counts, 0)
+    l_idx = jnp.repeat(row, c_counts, total_repeat_length=cand)
+    starts = jnp.cumsum(c_counts) - c_counts
+    pos = jnp.arange(cand) - jnp.repeat(starts, c_counts,
+                                        total_repeat_length=cand)
+    r_pos = jnp.repeat(lo, c_counts, total_repeat_length=cand) + pos
+    r_idx = jnp.take(order, jnp.clip(r_pos, 0, max(plen_r - 1, 0)))
+    return l_idx, r_idx
+
+
+def _chunk_spans(counts_np, budget):
+    """Greedy contiguous spans of probe rows whose candidate-pair sums stay
+    within ``budget`` (a single row exceeding it gets its own span).
+    Vectorized: this path triggers exactly when the probe side is large, so
+    a per-row Python loop would cost seconds of host time per join."""
+    n = len(counts_np)
+    cum = np.cumsum(counts_np, dtype=np.int64)
+    spans, s = [], 0
+    while s < n:
+        base = cum[s - 1] if s else 0
+        # last row index whose cumulative stays within budget from `base`
+        e = int(np.searchsorted(cum, base + budget, side="right"))
+        if e <= s:
+            e = s + 1                    # oversized single row: own span
+        spans.append((s, e))
+        s = e
+    return spans
+
+
+def _chunked_inner_join(left, right, left_keys, right_keys, probe,
+                        residual_fn) -> DeviceTable:
+    """Inner join materialized span-by-span so peak memory is bounded by
+    ``_PAIR_BUDGET`` pairs, with residual predicates applied per span
+    before anything is kept — the pair expansion never exists whole."""
+    counts, lo, order, total = probe
+    counts_np = np.asarray(counts)
+    spans = _chunk_spans(counts_np, _PAIR_BUDGET)
+    cum = np.concatenate([[0], np.cumsum(counts_np)])
+    parts, schema_chunk = [], None
+    for (s, e) in spans:
+        span_total = int(cum[e] - cum[s])
+        if span_total == 0:
+            continue
+        cand = bucket_len(span_total)
+        l_idx, r_idx = _span_pair_indices(counts, lo, order, s, e, cand)
+        ok = _verify_pairs(l_idx, r_idx, left_keys, right_keys)
+        ok = ok & live_mask(cand, span_total)
+        raw = DeviceTable(
+            {**gather_table_rows(left, l_idx, cand).columns,
+             **gather_table_rows(right, r_idx, cand).columns}, cand)
+        schema_chunk = raw
+        if residual_fn is not None:
+            ok = ok & residual_fn(raw)
+        n_live = int(jnp.sum(ok))                      # host sync per span
+        if n_live == 0:
+            continue
+        keep = compact_indices(ok, n_live)
+        parts.append(take_padded(raw, keep, n_live))
+    if not parts:
+        empty = jnp.zeros(bucket_len(0), dtype=jnp.int64)
+        return take_padded(schema_chunk, empty + schema_chunk.plen, 0)
+    return concat_tables(parts) if len(parts) > 1 else parts[0]
+
+
 def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
-                how: str = "inner", l_excl=None, r_excl=None) -> DeviceTable:
+                how: str = "inner", l_excl=None, r_excl=None,
+                residual_fn=None) -> DeviceTable:
     """Materialized equi-join of two tables; column name collisions must be
     resolved by the caller (planner aliases). ``l_excl``/``r_excl`` fold
-    deferred filter masks into the join (see :func:`join_indices`)."""
+    deferred filter masks into the join (see :func:`join_indices`).
+    ``residual_fn`` (inner joins) maps a materialized pair table to a keep
+    mask — non-equi residual predicates evaluated inside the join, before
+    (in the chunked path) any pair expansion is materialized whole."""
+    left_keys = [left[c] for c in left_on]
+    right_keys = [right[c] for c in right_on]
+    probe = None
+    if how == "inner":
+        probe = _probe_candidates(left_keys, right_keys,
+                                  n_left=left.nrows, n_right=right.nrows,
+                                  l_excl=l_excl, r_excl=r_excl)
+        if probe[3] > _PAIR_BUDGET:
+            return _chunked_inner_join(left, right, left_keys, right_keys,
+                                       probe, residual_fn)
     l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx = join_indices(
-        [left[c] for c in left_on], [right[c] for c in right_on], how,
+        left_keys, right_keys, how,
         n_left=left.nrows, n_right=right.nrows,
-        l_excl=l_excl, r_excl=r_excl)
+        l_excl=l_excl, r_excl=r_excl, probe=probe)
     matched = DeviceTable(
         {**gather_table_rows(left, l_idx, n_pairs).columns,
          **gather_table_rows(right, r_idx, n_pairs).columns}, n_pairs)
+    if residual_fn is not None and how == "inner":
+        mask = residual_fn(matched) & live_mask(matched.plen, n_pairs)
+        matched = compact_table(matched, mask)
     parts = [matched]
     if l_extra is not None and n_lx:
         cols = dict(gather_table_rows(left, l_extra, n_lx).columns)
@@ -773,21 +900,36 @@ def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
 # ---------------------------------------------------------------------------
 
 
+_union_cache: dict = {}
+
+
 def _align_str_dicts(cols):
     """(per-part code arrays, shared dictionary) for string columns whose
     dictionaries may differ: remap every part's codes into one merged
-    value table (identity fast path when all parts share one dictionary)."""
+    value table (identity fast path when all parts share one dictionary).
+    The merged dictionary is cached per input-dictionary identity tuple so
+    repeated executions hand out the SAME host object — downstream
+    identity-keyed caches (expression fusion, rank maps) would otherwise
+    miss and retrace every run."""
     dicts = [c.dict_values for c in cols]
     if all(d is dicts[0] for d in dicts):
         return [c.data for c in cols], dicts[0]
-    union, inverse = np.unique(
-        np.concatenate([d.astype(str) for d in dicts]), return_inverse=True)
-    datas, off = [], 0
-    for d, c in zip(dicts, cols):
-        m = jnp.asarray(inverse[off:off + len(d)].astype(np.int32))
-        datas.append(jnp.take(m, c.data))
-        off += len(d)
-    return datas, union.astype(object)
+
+    def compute():
+        union, inverse = np.unique(
+            np.concatenate([d.astype(str) for d in dicts]),
+            return_inverse=True)
+        # cache HOST arrays only: a device constant created inside a jit
+        # trace is a tracer, and caching one leaks it across traces
+        maps, off = [], 0
+        for d in dicts:
+            maps.append(inverse[off:off + len(d)].astype(np.int32))
+            off += len(d)
+        return maps, union.astype(object)
+
+    maps, union = _identity_cache(_union_cache, 256, tuple(dicts), compute)
+    return [jnp.take(jnp.asarray(m), c.data) for m, c in zip(maps, cols)], \
+        union
 
 
 def concat_columns(cols) -> Column:

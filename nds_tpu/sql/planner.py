@@ -45,6 +45,9 @@ _DEFER_FILTER_MAX_ROWS = int(
 # (dictionary identity refs, jitted callable | None-for-fallback)
 _MASK_FUSE_CACHE: dict = {}
 _MASK_FUSE_MAX = 4096
+# projection/aggregate-argument twin of the mask-fusion cache:
+# key -> (input dict identities, jitted fn | None, output (kind, dict) meta)
+_EXPR_FUSE_CACHE: dict = {}
 
 
 @dataclass
@@ -70,6 +73,7 @@ class Planner:
         # carry schema guarantees (PK uniqueness for gather joins)
         self.base_tables = base_tables if base_tables is not None else set()
         self.cte_stack: list[dict] = []
+        self._synth_keys = 0             # synthetic join-key name counter
         # bare column names the current statement references anywhere
         # (projection pushdown); None = pruning disabled (SELECT * present
         # or not yet computed)
@@ -237,13 +241,18 @@ class Planner:
         parts, join_preds, sources = self._flatten_from(from_)
         return self._join_parts(parts, join_preds, [], sources)
 
-    def _flatten_from(self, from_):
+    def _flatten_from(self, from_, where=None):
         """Flatten a FROM tree into (leaf tables, explicit-join predicates,
-        per-leaf catalog source names). Non-cross joins keep their structure
-        (executed pairwise); cross/comma joins flatten into the list for
-        WHERE-driven join ordering. ``sources[i]`` names the catalog table a
-        leaf scans (None for subqueries/materialized joins) — the provenance
-        the PK gather-join optimization keys on."""
+        per-leaf catalog source names). Cross/comma joins AND structured
+        INNER joins flatten into the list — an inner ON predicate is
+        semantically a WHERE conjunct, and flattening lets the join-graph
+        orderer see every equi edge at once (q72's item-only explosion
+        disappears once the week_seq WHERE edge joins the same slot pair).
+        Outer joins keep their structure, but WHERE conjuncts owned entirely
+        by the null-preserving side are consumed from ``where`` (a mutable
+        list) and pushed below the join. ``sources[i]`` names the catalog
+        table a leaf scans (None for subqueries/materialized joins) — the
+        provenance the PK gather-join optimization keys on."""
         if isinstance(from_, A.TableRef):
             alias = from_.alias or from_.name
             name_l = from_.name.lower()
@@ -265,18 +274,64 @@ class Planner:
             t = self.query(from_.query)
             return [self._alias_table(t, from_.alias)], [], [None]
         if isinstance(from_, A.Join):
-            if from_.kind == "cross":
-                lp, lj, ls = self._flatten_from(from_.left)
-                rp, rj, rs = self._flatten_from(from_.right)
-                return lp + rp, lj + rj, ls + rs
-            # structured join: materialize it now
-            lp, lj, ls = self._flatten_from(from_.left)
-            left = self._join_parts(lp, lj, [], ls)
-            rp, rj, rs = self._flatten_from(from_.right)
-            right = self._join_parts(rp, rj, [], rs)
+            if from_.kind in ("cross", "inner"):
+                lp, lj, ls = self._flatten_from(from_.left, where)
+                rp, rj, rs = self._flatten_from(from_.right, where)
+                cond = [h for c in self._split_conjuncts(from_.condition)
+                        for h in self._hoist_or_conjuncts(c)]
+                return lp + rp, lj + rj + cond, ls + rs
+            # outer join: materialize it, pushing WHERE conjuncts owned by
+            # the null-preserving side below the join first (for LEFT, a
+            # predicate over left columns only commutes with the join)
+            lp, lj, ls = self._flatten_from(
+                from_.left, where if from_.kind == "left" else None)
+            lw = self._consume_pushable(where, lp) \
+                if from_.kind == "left" else []
+            left = self._join_parts(lp, lj, lw, ls)
+            rp, rj, rs = self._flatten_from(
+                from_.right, where if from_.kind == "right" else None)
+            rw = self._consume_pushable(where, rp) \
+                if from_.kind == "right" else []
+            right = self._join_parts(rp, rj, rw, rs)
             joined = self._binary_join(left, right, from_.kind, from_.condition)
             return [joined], [], [None]
         raise ExecError(f"unsupported FROM clause {type(from_).__name__}")
+
+    def _consume_pushable(self, where, parts):
+        """Remove and return the conjuncts of ``where`` (in place) whose
+        every column reference resolves within ``parts`` and which carry no
+        subquery — the set safe to evaluate below an outer join on the
+        null-preserving side."""
+        if not where:
+            return []
+        cols = set()
+        for p in parts:
+            cols |= set(p.column_names)
+        taken = []
+        for c in list(where):
+            if self._has_subquery(c):
+                continue
+            if self._refs_resolve_in(c, cols):
+                taken.append(c)
+                where.remove(c)
+        return taken
+
+    def _refs_resolve_in(self, e, cols) -> bool:
+        """True when the expression references at least one column and every
+        column it references resolves within ``cols``."""
+        refs = []
+        ok = True
+
+        def walk(node):
+            nonlocal ok
+            if isinstance(node, A.ColumnRef):
+                refs.append(node)
+                if self._resolve_name(node, cols) is None:
+                    ok = False
+            for ch in self._child_exprs(node):
+                walk(ch)
+        walk(e)
+        return ok and bool(refs)
 
     # -------------------------------------------------------- join machinery
 
@@ -532,6 +587,46 @@ class Planner:
         walk(e)
         return out
 
+    def _synthetic_edge(self, c, parts, part_cols):
+        """Edge for an ``expr = expr`` conjunct whose sides each reference
+        exactly one (distinct) part: materialize both expressions as
+        synthetic key columns on their parts and return the edge tuple.
+        The flattened-join twin of :func:`_equi_key_cols`."""
+        def side_owner(e):
+            refs = self._column_refs(e)
+            if not refs:
+                return None
+            owner = None
+            for r in refs:
+                cands = [i for i, pc in enumerate(part_cols)
+                         if self._resolve_name(r, pc) is not None]
+                if len(cands) != 1:
+                    return None
+                if owner is None:
+                    owner = cands[0]
+                elif owner != cands[0]:
+                    return None
+            return owner
+
+        lo_, ro_ = side_owner(c.left), side_owner(c.right)
+        if lo_ is None or ro_ is None or lo_ == ro_:
+            return None
+        try:
+            lcol = self.eval_expr(c.left, EvalCtx(parts[lo_]))
+            rcol = self.eval_expr(c.right, EvalCtx(parts[ro_]))
+        except Exception:
+            return None                   # stays residual, as before
+        n = self._synth_keys
+        self._synth_keys += 1
+        ln, rn = f"__jk{n}_l", f"__jk{n}_r"
+        parts[lo_] = DeviceTable({**parts[lo_].columns, ln: lcol},
+                                 parts[lo_].nrows, plen=parts[lo_].plen)
+        part_cols[lo_].add(ln)
+        parts[ro_] = DeviceTable({**parts[ro_].columns, rn: rcol},
+                                 parts[ro_].nrows, plen=parts[ro_].plen)
+        part_cols[ro_].add(rn)
+        return (lo_, ro_, ln, rn)
+
     def _equi_key_cols(self, c, left: DeviceTable, right: DeviceTable):
         """(left key Column, right key Column) for an ``expr = expr`` conjunct
         whose sides each reference exactly one join input (e.g.
@@ -592,61 +687,144 @@ class Planner:
         if os.environ.get("NDS_TPU_NO_EXPR_FUSE") or \
                 any(self._has_subquery(c) for c in conjuncts):
             return self._conjunct_mask_eager(table, conjuncts)
-        # key and jit inputs cover only the columns the predicates can
-        # reference — unrelated columns changing shape must not retrace
+        plen = table.plen
+
+        def build_impl(ev, names, kinds, dict_refs, meta):
+            def impl(datas, valids):
+                tcols = {n: Column(k, d, v, dv) for n, k, d, v, dv in
+                         zip(names, kinds, datas, valids, dict_refs)}
+                # nrows deliberately = plen: expression evaluation must
+                # never depend on the logical count (pads are masked later)
+                return ev._conjunct_mask_eager(
+                    DeviceTable(tcols, plen, plen=plen), conjuncts)
+            return impl
+
+        got = self._fused_run(_MASK_FUSE_CACHE, table, conjuncts,
+                              build_impl, "predicate")
+        if got is None:
+            return self._conjunct_mask_eager(table, conjuncts)
+        return got[0]
+
+    def _fused_run(self, cache, table, exprs, build_impl, what):
+        """Shared expression-fusion machinery for :func:`_conjunct_mask` and
+        :func:`_prefuse_exprs`: referenced-column input selection, cache
+        keying by (expression keys, physical length, column signature),
+        dictionary-identity validation on hits, ONE jitted trace attempt
+        with pin-to-eager on trace-class errors, and FIFO eviction.
+
+        ``build_impl(ev, names, kinds, dict_refs, meta)`` returns the
+        function to jit (signature ``(datas, valids)``); ``ev`` is a
+        detached Planner (capturing ``self`` would pin this query's planner
+        and its device-resident contexts in the module cache for process
+        lifetime) and ``meta`` a list the impl may fill with static output
+        metadata as a tracing side effect. Returns ``(output, meta)`` or
+        None when the batch is unfusable/pinned (caller evaluates eager).
+        Runtime errors (device OOM, wedged RPC) propagate — swallowing one
+        would silently pin a fusable set to eager forever."""
         refs = {r.name.lower()
-                for c in conjuncts for r in self._column_refs(c)}
+                for c in exprs for r in self._column_refs(c)}
+        # inputs cover only the columns the expressions can reference —
+        # unrelated columns changing shape must not retrace
         names = [n for n in table.column_names if n.split(".")[-1] in refs]
         if not names:
-            return self._conjunct_mask_eager(table, conjuncts)
+            return None
         cols = [table.columns[n] for n in names]
         plen = table.plen
-        key = (tuple(expr_key(c) for c in conjuncts), plen,
+        key = (tuple(expr_key(c) for c in exprs), plen,
                tuple((n, c.kind, int(c.data.shape[0]), c.valid is not None)
                      for n, c in zip(names, cols)))
-        hit = _MASK_FUSE_CACHE.get(key)
+        hit = cache.get(key)
         if hit is not None and all(h is c.dict_values
                                    for h, c in zip(hit[0], cols)):
             fn = hit[1]
             if fn is None:
-                return self._conjunct_mask_eager(table, conjuncts)
+                return None
             return fn(tuple(c.data for c in cols),
-                      tuple(c.valid for c in cols))
+                      tuple(c.valid for c in cols)), hit[2]
         dict_refs = tuple(c.dict_values for c in cols)
         kinds = tuple(c.kind for c in cols)
-        # a DETACHED planner evaluates inside the trace: capturing self
-        # would pin this query's planner (and its device-resident contexts)
-        # in the module cache for process lifetime
         ev = Planner({}, base_tables=set())
-
-        def impl(datas, valids):
-            tcols = {n: Column(k, d, v, dv) for n, k, d, v, dv in
-                     zip(names, kinds, datas, valids, dict_refs)}
-            # nrows deliberately = plen: expression evaluation must never
-            # depend on the logical count (pads are masked later)
-            return ev._conjunct_mask_eager(
-                DeviceTable(tcols, plen, plen=plen), conjuncts)
-
-        fn = jax.jit(impl)
+        meta: list = []
+        fn = jax.jit(build_impl(ev, names, kinds, dict_refs, meta))
         try:
-            out = fn(tuple(c.data for c in cols), tuple(c.valid for c in cols))
+            out = fn(tuple(c.data for c in cols),
+                     tuple(c.valid for c in cols))
         except (TypeError, ValueError, NotImplementedError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.ConcretizationTypeError,
                 jax.errors.TracerBoolConversionError) as e:
-            # trace-time failures only: the conjunct set genuinely cannot be
-            # fused, so pin it to eager. Runtime errors (device OOM, wedged
-            # RPC) must propagate — swallowing one would silently pin a
-            # fusable set to eager forever.
             logging.getLogger(__name__).info(
-                "predicate fusion fell back to eager: %s: %s",
-                type(e).__name__, e)
-            fn = None
-            out = self._conjunct_mask_eager(table, conjuncts)
-        if len(_MASK_FUSE_CACHE) >= _MASK_FUSE_MAX:
-            _MASK_FUSE_CACHE.pop(next(iter(_MASK_FUSE_CACHE)))
-        _MASK_FUSE_CACHE[key] = (dict_refs, fn)
-        return out
+                "%s fusion fell back to eager: %s: %s",
+                what, type(e).__name__, e)
+            if len(cache) >= _MASK_FUSE_MAX:
+                cache.pop(next(iter(cache)))
+            cache[key] = (dict_refs, None, None)
+            return None
+        m = list(meta)
+        if len(cache) >= _MASK_FUSE_MAX:
+            cache.pop(next(iter(cache)))
+        cache[key] = (dict_refs, fn, m)
+        return out, m
+
+    def _has_window(self, e) -> bool:
+        found = False
+
+        def walk(node):
+            nonlocal found
+            if isinstance(node, A.WindowFunc):
+                found = True
+                return
+            for c in self._child_exprs(node):
+                walk(c)
+        walk(e)
+        return found
+
+    def _prefuse_exprs(self, table: DeviceTable, exprs, ctx: EvalCtx) -> None:
+        """Evaluate a batch of scalar expressions over ``table`` inside ONE
+        jitted program and seed the results into ``ctx.window_values`` (the
+        memo :func:`eval_expr` consults first), so the SELECT list and
+        aggregate arguments cost one device dispatch instead of one per
+        scalar op — the projection-side twin of :func:`_conjunct_mask`.
+        Output metadata (kind, dictionary) is captured as a tracing side
+        effect; trace failures (host-dependent expressions) pin the batch to
+        eager evaluation. Best-effort: callers proceed identically whether
+        or not anything was seeded."""
+        if os.environ.get("NDS_TPU_NO_EXPR_FUSE"):
+            return
+        seen, fusable = set(), []
+        for e in exprs:
+            k = expr_key(e)
+            if k in seen or k in ctx.window_values:
+                continue
+            seen.add(k)
+            if not self._has_subquery(e) and not self._has_window(e):
+                fusable.append((k, e))
+        # bare refs/literals gain nothing from fusion
+        if not any(not isinstance(e, (A.ColumnRef, A.Literal))
+                   for _, e in fusable):
+            return
+        plen = table.plen
+
+        def build_impl(ev, names, kinds, dict_refs, meta):
+            def impl(datas, valids):
+                tcols = {n: Column(k, d, v, dv) for n, k, d, v, dv in
+                         zip(names, kinds, datas, valids, dict_refs)}
+                tctx = EvalCtx(DeviceTable(tcols, plen, plen=plen))
+                outs = [ev.eval_expr(e, tctx) for _, e in fusable]
+                meta.clear()
+                meta.extend((c.kind, c.dict_values) for c in outs)
+                return (tuple(c.data for c in outs),
+                        tuple(c.valid for c in outs))
+            return impl
+
+        got = self._fused_run(_EXPR_FUSE_CACHE, table,
+                              [e for _, e in fusable], build_impl,
+                              "projection")
+        if got is None:
+            return
+        (datas, valids), meta = got
+        for (k, _), d, v, (kind, dv) in zip(fusable, datas, valids, meta):
+            ctx.window_values[k] = Column(kind, d, v, dv)
 
     def _filter_conjuncts(self, table: DeviceTable, conjuncts) -> DeviceTable:
         if not conjuncts:
@@ -706,6 +884,14 @@ class Planner:
                     li, ri = owner(lk), owner(rk)
                     if li is not None and ri is not None and li != ri:
                         pair = (li, ri, lk, rk)
+            if pair is None and isinstance(c, A.BinaryOp) and c.op == "=" \
+                    and len(owners) == 2:
+                # expression equi edge (``cast(a.x as date) = b.d + 1``):
+                # when each side's references live wholly in one part,
+                # materialize synthetic key columns and join on those —
+                # without this a flattened inner join whose only equi
+                # condition is an expression degrades to a cartesian
+                pair = self._synthetic_edge(c, parts, part_cols)
             if pair:
                 edges.append(pair)
             else:
@@ -772,9 +958,22 @@ class Planner:
             else:
                 l_on = [lk if sl == a else rk for (sl, sr, lk, rk) in es]
                 r_on = [rk if sl == a else lk for (sl, sr, lk, rk) in es]
+                # residual conjuncts fully in scope of this pair evaluate
+                # INSIDE the join (per chunk when it exceeds the pair
+                # budget): the q72-class expansion is filtered before it is
+                # ever materialized whole
+                pair_cols = set(tables[a].column_names) | \
+                    set(tables[b].column_names)
+                res_here = [c for c in residual
+                            if not self._has_subquery(c) and
+                            self._refs_resolve_in(c, pair_cols)]
+                residual = [c for c in residual if c not in res_here]
+                res_fn = (lambda t, rh=res_here: self._conjunct_mask(t, rh)) \
+                    if res_here else None
                 tables[a] = E.join_tables(tables[a], tables[b], l_on, r_on,
                                           "inner",
-                                          l_excl=masks[a], r_excl=masks[b])
+                                          l_excl=masks[a], r_excl=masks[b],
+                                          residual_fn=res_fn)
                 masks[a] = masks[b] = None   # consumed by the join
                 sources[a] = None            # physical rows are pair-expanded
             groups[b] = a
@@ -791,15 +990,21 @@ class Planner:
             out = self._cartesian(out, tables[s])
         # residual predicates apply on the fully joined result
         out = self._filter_conjuncts(out, residual)
+        # synthetic join keys must not leak into SELECT * expansion
+        if any(n.startswith("__jk") for n in out.column_names):
+            out = out.select([n for n in out.column_names
+                              if not n.startswith("__jk")])
         return out
 
     # ---------------------------------------------------------------- SELECT
 
     def select(self, sel: A.Select) -> DeviceTable:
-        parts, join_preds, sources = (([], [], []) if sel.from_ is None
-                                      else self._flatten_from(sel.from_))
         where_conjuncts = [h for c in self._split_conjuncts(sel.where)
                            for h in self._hoist_or_conjuncts(c)]
+        # _flatten_from consumes conjuncts it pushes below outer joins
+        parts, join_preds, sources = (([], [], []) if sel.from_ is None
+                                      else self._flatten_from(sel.from_,
+                                                              where_conjuncts))
         if sel.from_ is None:
             table = DeviceTable({}, 1, plen=E.bucket_len(1))
             table = self._filter_conjuncts(table, where_conjuncts)
@@ -817,6 +1022,9 @@ class Planner:
         else:
             ctx = EvalCtx(table)
             self._eval_windows(sel, ctx)
+            self._prefuse_exprs(
+                table, [it.expr for it in sel.items
+                        if not isinstance(it.expr, A.Star)], ctx)
             out = self._project(sel, ctx)
         if sel.distinct:
             out = self._distinct(out)
@@ -878,6 +1086,14 @@ class Planner:
         group_by = sel.group_by or A.GroupingSets("plain", [[]], [])
         base_ctx = EvalCtx(table)
         group_exprs = group_by.exprs
+        # one fused dispatch for the group keys and every aggregate's
+        # argument expression (q4/q11-class SELECTs aggregate arithmetic
+        # over 4-5 columns x 8 aggregates; eager evaluation pays per-op)
+        self._prefuse_exprs(
+            table,
+            list(group_exprs) + [c.args[0] for c in agg_calls.values()
+                                 if c.args and not c.star],
+            base_ctx)
         key_cols = [self.eval_expr(e, base_ctx) for e in group_exprs]
         key_names = [expr_key(e) for e in group_exprs]
 

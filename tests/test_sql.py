@@ -448,3 +448,21 @@ def test_projection_pushdown_shapes():
                  "where i.w = o.w) order by k").collect() == [(2,), (4,)]
     # SELECT * disables pruning: all 9 columns come back
     assert s.sql("select * from wide where k = 1").to_arrow().num_columns == 9
+
+
+def test_inner_join_on_expression_equi_key():
+    """A structured INNER join whose only equi condition is an expression
+    must hash-join on synthesized keys, not degrade to a cartesian (the
+    flattened-join twin of _equi_key_cols)."""
+    import pyarrow as pa
+    from nds_tpu.engine.session import Session
+    s = Session()
+    s.create_temp_view("a", pa.table({"x": pa.array([1, 2, 3, 4], pa.int64()),
+                                      "p": pa.array([10, 20, 30, 40], pa.int64())}))
+    s.create_temp_view("b", pa.table({"y": pa.array([2, 4, 6, 99], pa.int64()),
+                                      "q": pa.array([1, 2, 3, 4], pa.int64())}))
+    r = s.sql("select x, q from a join b on (x * 2 = y) order by x")
+    assert r.collect() == [(1, 1), (2, 2), (3, 3)]
+    # synthetic keys must not leak into SELECT *
+    r2 = s.sql("select * from a join b on (x * 2 = y)")
+    assert set(r2.column_names) == {"x", "p", "y", "q"}
