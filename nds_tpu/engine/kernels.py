@@ -176,3 +176,99 @@ def segment_sum_fused(weights, gids, num_segments: int):
     return sums, counts
 
 
+
+
+# ---------------------------------------------------------------------------
+# segment min/max (VPU tiled reduce over the same one-hot membership tiling)
+# ---------------------------------------------------------------------------
+
+_F32_MAX = 3.4e38
+
+
+def _seg_minmax_kernel(gid_ref, v_ref, min_ref, max_ref):
+    """One (group-tile j, row-tile i) cell: masked row-tile min and max per
+    group. Same grid discipline as :func:`_seg_kernel` (rows innermost)."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        min_ref[:] = jnp.full_like(min_ref, _F32_MAX)
+        max_ref[:] = jnp.full_like(max_ref, -_F32_MAX)
+
+    gid = gid_ref[:]                     # (1, TR) int32, -1 = masked row
+    v = v_ref[:].astype(jnp.float32)     # (1, TR)
+    j = pl.program_id(0)
+    gbase = j * _TG
+    groups = gbase + jax.lax.broadcasted_iota(jnp.int32, (_TR, _TG), 1)
+    member = gid.reshape(_TR, 1) == groups              # (TR, TG) bool
+    vb = v.reshape(_TR, 1)
+    # sentinels must be f32 CONSTANTS: a bare Python float weak-types to
+    # f64 under jax_enable_x64 and Mosaic cannot legalize the tpu.truncf
+    # the promotion would need
+    big = jnp.float32(_F32_MAX)
+    lo = jnp.where(member, vb, big)
+    hi = jnp.where(member, vb, -big)
+    min_ref[:] = jnp.minimum(min_ref[:], jnp.min(lo, axis=0).reshape(1, _TG))
+    max_ref[:] = jnp.maximum(max_ref[:], jnp.max(hi, axis=0).reshape(1, _TG))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _segment_minmax_pallas(gids, values, num_segments: int, interpret: bool):
+    n = gids.shape[0]
+    n_pad = max(_ceil_to(n, _TR), _TR)
+    g_pad = max(_ceil_to(num_segments, _TG), _TG)
+    gid_p = jnp.full(n_pad, -1, dtype=jnp.int32).at[:n].set(
+        gids.astype(jnp.int32))
+    v_p = jnp.zeros(n_pad, dtype=jnp.float32).at[:n].set(
+        values.astype(jnp.float32))
+    grid = (g_pad // _TG, n_pad // _TR)
+    mins, maxs = pl.pallas_call(
+        _seg_minmax_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _TR), lambda j, i: (j - j, i)),
+            pl.BlockSpec((1, _TR), lambda j, i: (j - j, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _TG), lambda j, i: (i - i, j)),
+            pl.BlockSpec((1, _TG), lambda j, i: (i - i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, g_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, g_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gid_p.reshape(1, n_pad), v_p.reshape(1, n_pad))
+    return mins[0, :num_segments], maxs[0, :num_segments]
+
+
+def segment_minmax_fused(values, gids, num_segments: int):
+    """(mins f32[G], maxs f32[G]) of ``values`` grouped by ``gids`` (rows
+    with gid < 0 excluded; empty groups come back as +/-_F32_MAX). Pallas
+    VPU path on TPU under the same small-group-count gate as
+    :func:`segment_sum_fused`; XLA segment ops elsewhere.
+
+    f32 precision note: like the sum kernel this is the opt-in float path —
+    the engine's exact decimal/int64 min/max stays on XLA (f32 rounding
+    would corrupt exact comparisons).
+    """
+    global _pallas_broken
+    mode = _pallas_mode()
+    if mode != "off" and not _pallas_broken and \
+            num_segments <= _MAX_GROUPS:
+        try:
+            return _segment_minmax_pallas(gids, values, num_segments,
+                                          mode == "interpret")
+        except Exception:  # Mosaic unsupported on this attachment
+            _pallas_broken = True
+            import sys
+            print("# pallas kernels disabled; using XLA fallback",
+                  file=sys.stderr)
+    live = gids >= 0
+    safe = jnp.where(live, gids, 0)
+    v = values.astype(jnp.float32)
+    mins = jax.ops.segment_min(jnp.where(live, v, _F32_MAX), safe,
+                               num_segments=num_segments)
+    maxs = jax.ops.segment_max(jnp.where(live, v, -_F32_MAX), safe,
+                               num_segments=num_segments)
+    return mins, maxs

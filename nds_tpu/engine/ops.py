@@ -351,8 +351,20 @@ def _agg_count_impl(valid, gids, ngroups):
 def agg_count(col: Column | None, gids, ngroups) -> Column:
     """count(*) when col is None else count(col) (non-null). Pad rows need
     no masking here: grouping routes them to a trailing group that lands
-    past the logical group count or is dropped by the segment op."""
+    past the logical group count or is dropped by the segment op.
+
+    Counts are exactly representable in f32 below 2^24 rows, so unlike the
+    decimal sums this EXACT aggregate can ride the Pallas MXU kernel —
+    count appears in nearly every query (count(*), avg validity), which is
+    what makes the kernel hot on the default exact-decimal bench."""
     valid = None if col is None else col.valid
+    if int(gids.shape[0]) < (1 << 24):
+        from nds_tpu.engine.kernels import pallas_active, segment_sum_fused
+        if pallas_active(ngroups):
+            g = gids if valid is None else jnp.where(valid, gids, -1)
+            _, counts = segment_sum_fused(
+                jnp.zeros(gids.shape[0], dtype=jnp.float32), g, ngroups)
+            return Column("i64", counts.astype(jnp.int64))
     return Column("i64", _agg_count_impl(valid, gids, ngroups))
 
 
@@ -403,6 +415,20 @@ def _agg_min_impl(view, valid, gids, ngroups, is_max):
 
 
 def agg_min(col: Column, gids, ngroups, is_max=False) -> Column:
+    if col.kind == "f64":
+        from nds_tpu.engine.kernels import pallas_active, \
+            segment_minmax_fused
+        if pallas_active(ngroups):
+            # float min/max rides the tiled one-hot kernel; exact kinds
+            # (int/decimal/string ranks) stay on the XLA path below
+            valid = col.valid_mask()
+            g = jnp.where(valid, gids, -1)
+            mins, maxs = segment_minmax_fused(col.data, g, ngroups)
+            cnt = jax.ops.segment_sum(valid.astype(jnp.int32),
+                                      jnp.where(valid, gids, 0),
+                                      num_segments=ngroups)
+            out = (maxs if is_max else mins).astype(jnp.float64)
+            return Column("f64", jnp.where(cnt > 0, out, 0.0), cnt > 0)
     out, out_valid = _agg_min_impl(sortable_view(col), col.valid, gids,
                                    ngroups, bool(is_max))
     if col.kind == "str":

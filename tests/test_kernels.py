@@ -74,3 +74,32 @@ def test_pallas_mode_off_without_tpu(monkeypatch):
     monkeypatch.setenv("NDS_TPU_PALLAS", "auto")
     if jax.default_backend() != "tpu":
         assert kernels._pallas_mode() == "off"
+
+
+@pytest.mark.parametrize("n,groups", [(1, 1), (1000, 130), (5000, 513)])
+def test_segment_minmax_fused_interpret(interpret_mode, n, groups):
+    rng = np.random.default_rng(11)
+    gids = jnp.asarray(rng.integers(-1, groups, n).astype(np.int32))
+    vals = jnp.asarray((rng.random(n) * 200 - 100).astype(np.float32))
+    mins, maxs = kernels.segment_minmax_fused(vals, gids, groups)
+    g_np, v_np = np.asarray(gids), np.asarray(vals)
+    for g in range(groups):
+        sel = v_np[g_np == g]
+        if len(sel):
+            assert np.isclose(float(mins[g]), sel.min(), rtol=1e-6)
+            assert np.isclose(float(maxs[g]), sel.max(), rtol=1e-6)
+        else:
+            assert float(mins[g]) == float(np.float32(kernels._F32_MAX))
+            assert float(maxs[g]) == float(np.float32(-kernels._F32_MAX))
+
+
+def test_segment_minmax_group_gate(monkeypatch):
+    """Above the group-count gate the XLA path must be taken (and agree)."""
+    monkeypatch.setenv("NDS_TPU_PALLAS", "interpret")
+    monkeypatch.setattr(kernels, "_MAX_GROUPS", 4)
+    gids = jnp.asarray(np.array([0, 1, 5, 5, 3], dtype=np.int32))
+    vals = jnp.asarray(np.array([1.0, -2.0, 7.0, 3.0, 0.5], dtype=np.float32))
+    mins, maxs = kernels.segment_minmax_fused(vals, gids, 6)
+    assert float(mins[5]) == 3.0 and float(maxs[5]) == 7.0
+    assert not kernels.pallas_active(6)
+    assert kernels.pallas_active(4)
