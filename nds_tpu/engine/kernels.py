@@ -164,6 +164,9 @@ def segment_sum_fused(weights, gids, num_segments: int):
                                        mode == "interpret")
         except Exception as e:  # Mosaic unsupported on this attachment
             _pallas_broken = True
+            from nds_tpu.listener import report_task_failure
+            report_task_failure("pallas segment-sum kernel "
+                                "(permanent XLA fallback)", e)
             import sys
             print(f"# pallas kernels disabled ({type(e).__name__}); "
                   f"using XLA fallback", file=sys.stderr)
@@ -259,8 +262,11 @@ def segment_minmax_fused(values, gids, num_segments: int):
         try:
             return _segment_minmax_pallas(gids, values, num_segments,
                                           mode == "interpret")
-        except Exception:  # Mosaic unsupported on this attachment
+        except Exception as e:  # Mosaic unsupported on this attachment
             _pallas_broken = True
+            from nds_tpu.listener import report_task_failure
+            report_task_failure("pallas segment-min/max kernel "
+                                "(permanent XLA fallback)", e)
             import sys
             print("# pallas kernels disabled; using XLA fallback",
                   file=sys.stderr)

@@ -878,6 +878,39 @@ def _chunked_inner_join(left, right, left_keys, right_keys, probe,
     return concat_tables(parts) if len(parts) > 1 else parts[0]
 
 
+def _exchange_inner_join(left, right, left_keys, right_keys, mesh,
+                         l_excl, r_excl, residual_fn) -> DeviceTable:
+    """Repartition join over the mesh: both sides are row-sharded (too big
+    for the broadcast threshold), so their (hash, row id) pairs move through
+    the ICI all-to-all exchange and the probe runs device-local on
+    co-partitioned key ranges (the planner's repartition-join arm; SURVEY.md
+    §5.8, the UCX-shuffle role of the reference's accelerated stack)."""
+    from nds_tpu.parallel.exchange import exchange_join_pairs
+    plen_l = len(left_keys[0])
+    plen_r = len(right_keys[0])
+    lviews, rviews = _hash_views(left_keys, right_keys)
+    lh = _key_hash_impl(lviews, tuple(c.valid for c in left_keys), 0,
+                        False, left.nrows, l_excl)
+    rh = _key_hash_impl(rviews, tuple(c.valid for c in right_keys), 1,
+                        False, right.nrows, r_excl)
+    l_idx_x, r_idx_x, live = exchange_join_pairs(
+        lh, jnp.arange(plen_l, dtype=jnp.int64),
+        rh, jnp.arange(plen_r, dtype=jnp.int64), mesh)
+    ok = live & _verify_pairs(l_idx_x, r_idx_x, left_keys, right_keys)
+    n_pairs = int(jnp.sum(ok))                         # host sync
+    keep = jnp.nonzero(ok, size=bucket_len(n_pairs),
+                       fill_value=int(ok.shape[0]))[0]
+    l_idx = jnp.take(l_idx_x, keep, mode="fill", fill_value=plen_l)
+    r_idx = jnp.take(r_idx_x, keep, mode="fill", fill_value=plen_r)
+    matched = DeviceTable(
+        {**gather_table_rows(left, l_idx, n_pairs).columns,
+         **gather_table_rows(right, r_idx, n_pairs).columns}, n_pairs)
+    if residual_fn is not None:
+        mask = residual_fn(matched) & live_mask(matched.plen, n_pairs)
+        matched = compact_table(matched, mask)
+    return matched
+
+
 def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
                 how: str = "inner", l_excl=None, r_excl=None,
                 residual_fn=None) -> DeviceTable:
@@ -891,6 +924,15 @@ def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
     right_keys = [right[c] for c in right_on]
     probe = None
     if how == "inner":
+        from nds_tpu.parallel.exchange import mesh_of
+        lm = mesh_of(*(c.data for c in left_keys))
+        rm = mesh_of(*(c.data for c in right_keys))
+        if lm is not None and rm is not None:
+            # both sides row-sharded => repartition join over the exchange
+            # (tables under the broadcast threshold are replicated at load,
+            # so fact x dim joins never take this path)
+            return _exchange_inner_join(left, right, left_keys, right_keys,
+                                        lm, l_excl, r_excl, residual_fn)
         probe = _probe_candidates(left_keys, right_keys,
                                   n_left=left.nrows, n_right=right.nrows,
                                   l_excl=l_excl, r_excl=r_excl)

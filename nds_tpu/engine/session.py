@@ -98,14 +98,31 @@ class Session:
     # -- catalog ------------------------------------------------------------
 
     def _shard_table(self, table: DeviceTable) -> DeviceTable:
-        """Row-shard every column over the session mesh (no-op without
-        one)."""
+        """Place a table over the session mesh (no-op without one).
+
+        The broadcast-vs-shard decision is made here, at load time: tables
+        under the broadcast byte threshold are REPLICATED (every device
+        holds the whole table, so joins against them are local probes — the
+        all-gather-join side of the planner's broadcast/repartition choice,
+        Spark's autoBroadcastJoinThreshold analog); larger tables are
+        row-sharded, and big x big joins repartition through the ICI
+        all-to-all exchange (engine/ops.py join path, parallel/exchange.py).
+        Ref: SURVEY.md §5.8, nds/power_run_cpu.template:30."""
         if self.mesh is None:
             return table
+        import os
+
         import jax
         from dataclasses import replace as _replace
         from jax.sharding import NamedSharding, PartitionSpec as P
-        sh = NamedSharding(self.mesh, P("part"))
+        limit = int(self.conf.get(
+            "broadcast_bytes",
+            os.environ.get("NDS_TPU_BROADCAST_BYTES", str(128 << 20))))
+        approx = sum(c.data.nbytes +
+                     (c.valid.nbytes if c.valid is not None else 0)
+                     for c in table.columns.values())
+        spec = P() if approx <= limit else P("part")
+        sh = NamedSharding(self.mesh, spec)
         cols = {}
         for n, c in table.columns.items():
             cols[n] = _replace(

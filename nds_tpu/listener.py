@@ -55,14 +55,17 @@ class Manager:
     Listeners are scoped to the thread that registered them: concurrent
     in-process query streams (Throughput Run) each see only their own task
     failures. Failures raised from a thread with no scoped listener (e.g. a
-    shared device-runtime callback thread) fan out to every listener, since
-    they cannot be attributed to one stream. Engine partition workers report
-    through their owning query's listener explicitly (executor carries it).
+    shared device-runtime callback thread) are recorded in
+    ``Manager.unattributed`` for diagnostics but are NOT fanned out — one
+    stream's device error must never mark every concurrent stream
+    ``CompletedWithTaskFailures``.
     """
 
     _listeners: list[FailureListener] = []       # (owner_thread_id, listener) pairs
     _owners: list[int] = []
     _lock = threading.Lock()
+    unattributed: list[TaskFailure] = []
+    _UNATTRIBUTED_MAX = 1000
 
     @classmethod
     def register(cls, listener: FailureListener) -> None:
@@ -83,13 +86,25 @@ class Manager:
     def notify_all(cls, where: str, reason: str, fatal: bool = False) -> None:
         me = threading.get_ident()
         with cls._lock:
-            scoped = [l for l, o in zip(cls._listeners, cls._owners) if o == me]
-            targets = scoped if scoped else list(cls._listeners)
+            targets = [l for l, o in zip(cls._listeners, cls._owners)
+                       if o == me]
+            if not targets:
+                if len(cls.unattributed) >= cls._UNATTRIBUTED_MAX:
+                    cls.unattributed.pop(0)
+                cls.unattributed.append(TaskFailure(where, reason, fatal))
+                return
         for l in targets:
             l.notify(where, reason, fatal)
 
 
-def report_task_failure(where: str, exc: BaseException, fatal: bool = False) -> None:
-    """Engine-side hook: call on any retried partition task or device error."""
-    reason = "".join(traceback.format_exception_only(type(exc), exc)).strip()
+def report_task_failure(where: str, exc: BaseException | str,
+                        fatal: bool = False) -> None:
+    """Engine-side hook: call on any retried partition task, capacity
+    retry, kernel fallback, or device error. ``exc`` may be a caught
+    exception or a plain reason string (for retries that raised nothing)."""
+    if isinstance(exc, BaseException):
+        reason = "".join(
+            traceback.format_exception_only(type(exc), exc)).strip()
+    else:
+        reason = str(exc)
     Manager.notify_all(where, reason, fatal)

@@ -145,3 +145,148 @@ def sharded_filter_agg_step(mesh: Mesh, num_groups: int, capacity: int,
         NamedSharding(mesh, P(axis)), NamedSharding(mesh, P()),
         NamedSharding(mesh, P()))
     return jax.jit(sharded, in_shardings=in_shardings)
+
+
+def mesh_of(*arrays):
+    """The >1-device mesh a set of arrays is row-sharded over, or None.
+    Arrays are self-describing (their NamedSharding carries the mesh), so
+    the engine needs no session plumbing to detect distributed inputs."""
+    for a in arrays:
+        sh = getattr(a, "sharding", None)
+        if isinstance(sh, NamedSharding) and sh.mesh.devices.size > 1 and \
+                any(s is not None for s in sh.spec):
+            return sh.mesh
+    return None
+
+
+def _pow2(n: int) -> int:
+    p = 16
+    while p < n:
+        p *= 2
+    return p
+
+
+def _exchange_join_step(mesh, cap_in: int, pair_cap: int, axis: str):
+    """Jitted shard_map step of the repartition join: hash-bucketize both
+    sides' (hash, global row id) pairs, all_to_all them so equal hashes
+    co-locate, then locally sort/probe and emit matched row-id pairs at
+    fixed capacity. Overflow counts come back host-visible so the caller
+    can retry with doubled capacities (the static-shape analog of a
+    shuffle spill; SURVEY.md §5.8)."""
+    n_parts = mesh.devices.size
+
+    def local(lh, lrow, rh, rrow):
+        out = []
+        for h, row in ((lh, lrow), (rh, rrow)):
+            # bit 2 marks a REAL (matchable) hash (_key_hash_impl tags
+            # unmatchable rows with per-row sentinels); dead rows are
+            # dropped before the exchange so they never consume capacity
+            real = (h & jnp.uint64(4)) != 0
+            dest = jnp.where(real, hash_partition_dest(h, n_parts),
+                             jnp.int32(0))
+            n = h.shape[0]
+            order = jnp.argsort(jnp.where(real, dest, jnp.int32(n_parts)))
+            sd = jnp.take(dest, order)
+            sreal = jnp.take(real, order)
+            first = jnp.searchsorted(sd, sd, side="left")
+            pos = jnp.arange(n) - first
+            fits = (pos < cap_in) & sreal
+            # deficit (not count): the retry sizes capacity in ONE step
+            # even under quadratic key skew
+            bucket_counts = jax.ops.segment_sum(
+                sreal.astype(jnp.int64), sd, num_segments=n_parts)
+            over = jnp.maximum(jnp.max(bucket_counts) - cap_in, 0)
+            valid = jnp.zeros((n_parts, cap_in), dtype=bool).at[
+                sd, pos].set(fits, mode="drop")
+            bufs = {}
+            for name, arr in (("h", jnp.take(h, order)),
+                              ("row", jnp.take(row, order))):
+                bufs[name] = jnp.zeros(
+                    (n_parts, cap_in), dtype=arr.dtype).at[sd, pos].set(
+                    jnp.where(fits, arr, jnp.zeros((), dtype=arr.dtype)),
+                    mode="drop")
+            ex, vex = all_to_all_exchange(bufs, valid, axis)
+            out.append((ex["h"].reshape(-1), ex["row"].reshape(-1),
+                        vex.reshape(-1), over))
+        (lhx, lrx, lvx, lover), (rhx, rrx, rvx, rover) = out
+        # local probe: equal hashes are now co-resident on this device
+        m = rhx.shape[0]
+        rh_key = jnp.where(rvx, rhx, jnp.uint64(0))     # invalid -> hash 0
+        rorder = jnp.argsort(rh_key)
+        rh_sorted = jnp.take(rh_key, rorder)
+        lh_key = jnp.where(lvx, lhx, jnp.uint64(1))     # never matches 0
+        lo = jnp.searchsorted(rh_sorted, lh_key, side="left")
+        hi = jnp.searchsorted(rh_sorted, lh_key, side="right")
+        counts = jnp.where(lvx, hi - lo, 0)
+        total = jnp.sum(counts)
+        l_pos = jnp.repeat(jnp.arange(m), counts,
+                           total_repeat_length=pair_cap)
+        starts = jnp.cumsum(counts) - counts
+        pos = jnp.arange(pair_cap) - jnp.repeat(starts, counts,
+                                                total_repeat_length=pair_cap)
+        r_pos = jnp.repeat(lo, counts, total_repeat_length=pair_cap) + pos
+        pair_live = jnp.arange(pair_cap) < jnp.minimum(total, pair_cap)
+        l_out = jnp.take(lrx, l_pos, mode="clip")
+        r_out = jnp.take(rrx, jnp.take(rorder, jnp.clip(r_pos, 0, m - 1)),
+                         mode="clip")
+        p_over = jnp.maximum(total - pair_cap, 0)
+        overs = jax.lax.pmax(
+            jnp.stack([lover.astype(jnp.int64), rover.astype(jnp.int64),
+                       p_over.astype(jnp.int64)]), axis)
+        return l_out, r_out, pair_live, overs
+
+    try:
+        from jax import shard_map
+        rep_kw = {"check_vma": False}
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+        rep_kw = {"check_rep": False}
+
+    sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P()),
+        **rep_kw)
+    return jax.jit(sharded)
+
+
+_exchange_step_cache: dict = {}
+
+
+def exchange_join_pairs(lh, lrow, rh, rrow, mesh, axis: str = "part"):
+    """Repartition (all-to-all) join of two row-sharded hash columns.
+
+    Returns ``(l_idx, r_idx, pair_live)`` — global row-id pairs whose
+    hashes matched, at a fixed capacity with a validity mask — after
+    retrying with doubled capacities whenever a bucket or the pair buffer
+    overflowed (detected via the psum'd overflow counters; the implemented
+    overflow recovery the capacity-bucket design calls for)."""
+    n_parts = mesh.devices.size
+    n_l, n_r = int(lh.shape[0]), int(rh.shape[0])
+    # expected rows per (device, destination) bucket with 2x slack
+    cap_in = _pow2(max(n_l, n_r) * 2 // (n_parts * n_parts) + 16)
+    pair_cap = _pow2(max(n_l, n_r) * 2 // n_parts + 16)
+    for _ in range(5):
+        key = (id(mesh), cap_in, pair_cap, axis)
+        step = _exchange_step_cache.get(key)
+        if step is None:
+            step = _exchange_step_cache[key] = _exchange_join_step(
+                mesh, cap_in, pair_cap, axis)
+        l_idx, r_idx, live, overs = step(lh, lrow, rh, rrow)
+        lo, ro, po = (int(x) for x in overs)
+        if lo == 0 and ro == 0 and po == 0:
+            return l_idx, r_idx, live
+        # overs carry the max DEFICIT, so one retry reaches a sufficient
+        # capacity even under quadratic key skew. A retry is a recovered
+        # task failure in the reference's taxonomy (a shuffle spill/retry):
+        # surface it to the run's failure listener.
+        if lo or ro:
+            cap_in = _pow2(cap_in + max(lo, ro))
+        if po:
+            pair_cap = _pow2(pair_cap + po)
+        from nds_tpu.listener import report_task_failure
+        report_task_failure(
+            "exchange join capacity retry",
+            f"bucket deficit l={lo} r={ro}, pair deficit {po}; "
+            f"retrying with cap_in={cap_in}, pair_cap={pair_cap}")
+    raise RuntimeError("exchange join: capacity retry limit exceeded")

@@ -17,6 +17,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# virtual multi-device mesh for --mesh parity runs (must precede jax init)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
 # same-machine dev loop: persistent compile cache cuts re-sweeps ~3x
 os.environ.setdefault("NDS_TPU_COMP_CACHE", "force")
 import jax  # noqa: E402  (site hook may re-pin the platform; force cpu)
@@ -43,6 +48,9 @@ def main():
     ap.add_argument("--queries", help="comma list like query5,query14_part1")
     ap.add_argument("--update-lst", action="store_true")
     ap.add_argument("--full-trace", action="store_true")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="also run every query on an N-device mesh Session "
+                         "and require row-for-row parity with single-device")
     args = ap.parse_args()
 
     from nds_tpu.queries import generate_query_streams, list_templates
@@ -63,28 +71,43 @@ def main():
         queries = {k: v for k, v in queries.items() if k in want}
 
     session = Session()
+    sessions = [session]
+    if args.mesh:
+        sessions.append(Session(conf={"mesh_shape": args.mesh}))
     schemas = get_schemas(use_decimal=True)
-    for tname, fields in schemas.items():
-        for path in (os.path.join(data_dir, tname),
-                     os.path.join(data_dir, tname + ".dat")):
-            if os.path.exists(path):
-                session.read_raw_view(tname, path, fields)
-                break
+    for sess in sessions:
+        for tname, fields in schemas.items():
+            for path in (os.path.join(data_dir, tname),
+                         os.path.join(data_dir, tname + ".dat")):
+                if os.path.exists(path):
+                    sess.read_raw_view(tname, path, fields)
+                    break
 
     passed, failed = [], {}
     for qname, qtext in queries.items():
         t0 = time.perf_counter()
         try:
             res = session.sql(qtext)
-            res.collect()
+            rows = res.collect()
             ms = (time.perf_counter() - t0) * 1000
+            if args.mesh:
+                mrows = sessions[1].sql(qtext).collect()
+                if mrows != rows:
+                    # unordered parity: ORDER BY keys can tie, and tied-row
+                    # order is implementation-defined (the validation driver
+                    # has --ignore_ordering for the same reason)
+                    if sorted(map(repr, mrows)) != sorted(map(repr, rows)):
+                        raise AssertionError(
+                            f"mesh({args.mesh}) results diverge: "
+                            f"{len(mrows)} vs {len(rows)} rows")
             passed.append((qname, ms))
-            print(f"PASS {qname:22s} {ms:8.1f} ms  rows={res.num_rows}")
+            print(f"PASS {qname:22s} {ms:8.1f} ms  rows={res.num_rows}",
+                  flush=True)
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
             first = err.splitlines()[0][:110]
             failed.setdefault(first, []).append(qname)
-            print(f"FAIL {qname:22s} {first}")
+            print(f"FAIL {qname:22s} {first}", flush=True)
             if args.full_trace:
                 traceback.print_exc()
 
