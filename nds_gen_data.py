@@ -138,10 +138,10 @@ def generate_data_dist(args, tool_path, range_start, range_end):
         print("no host list for dist mode; running locally")
         return generate_data_local(args, tool_path, range_start, range_end)
     data_dir = _prepare_out_dir(args)
-    procs = []
-    for host, (lo, hi) in zip(host_list, _split_ranges(range_start, range_end, len(host_list))):
-        sub = [sys.executable, os.path.abspath(__file__), "local", args.scale,
-               str(args.parallel), get_abs_path(args.data_dir),
+
+    def spawn(host, lo, hi):
+        sub = [sys.executable, os.path.abspath(__file__), "local",
+               args.scale, str(args.parallel), get_abs_path(args.data_dir),
                "--range", f"{lo},{hi}"]
         if args.update:
             sub += ["--update", args.update]
@@ -149,11 +149,40 @@ def generate_data_dist(args, tool_path, range_start, range_end):
             sub += ["--overwrite_output"]
         if args.rngseed:
             sub += ["--rngseed", args.rngseed]
-        procs.append(subprocess.Popen(["ssh", host] + sub))
-    failed = [p for p in procs if p.wait() != 0]
-    if failed:
-        raise RuntimeError(f"{len(failed)} host(s) failed during distributed generation")
-    print(f"distributed generation complete across {len(host_list)} hosts -> {data_dir}")
+        return subprocess.Popen(["ssh", host] + sub)
+
+    spans = _split_ranges(range_start, range_end, len(host_list))
+    procs = [(h, lo, hi, spawn(h, lo, hi))
+             for h, (lo, hi) in zip(host_list, spans)]
+    # failure recovery (the MR wrapper retries failed map tasks,
+    # ref: GenTable.java mapreduce defaults): a failed host's chunk range
+    # is re-run on a surviving host rather than aborting the whole run
+    failed_spans, ok_hosts = [], []
+    for host, lo, hi, p in procs:
+        if p.wait() != 0:
+            print(f"host {host} failed for range {lo},{hi}; will retry")
+            failed_spans.append((lo, hi))
+        else:
+            ok_hosts.append(host)
+    for attempt in range(2):
+        if not failed_spans:
+            break
+        if not ok_hosts:
+            raise RuntimeError(
+                "distributed generation failed on every host")
+        retry = [(ok_hosts[i % len(ok_hosts)], lo, hi)
+                 for i, (lo, hi) in enumerate(failed_spans)]
+        failed_spans = []
+        rps = [(h, lo, hi, spawn(h, lo, hi)) for h, lo, hi in retry]
+        for host, lo, hi, p in rps:
+            if p.wait() != 0:
+                print(f"retry on {host} failed for range {lo},{hi}")
+                failed_spans.append((lo, hi))
+    if failed_spans:
+        raise RuntimeError(
+            f"ranges still failing after retries: {failed_spans}")
+    print(f"distributed generation complete across {len(host_list)} hosts "
+          f"-> {data_dir}")
 
 
 def _prepare_out_dir(args):

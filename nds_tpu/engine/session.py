@@ -53,6 +53,8 @@ class Session:
     def __init__(self, conf: dict | None = None):
         import os
 
+        from nds_tpu.parallel.multihost import maybe_initialize
+        maybe_initialize()       # multi-host federation precedes backend use
         from nds_tpu import enable_compile_cache
         enable_compile_cache()   # backend is resolved by session time
         self.conf = dict(conf or {})

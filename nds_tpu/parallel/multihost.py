@@ -1,0 +1,83 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Multi-host (pod / multi-slice) initialization: the DCN story.
+
+The reference scales past one machine with a Hadoop/Spark cluster (MR
+data-gen wrapper, Spark RPC + shuffle; ref:
+nds/tpcds-gen/src/main/java/org/notmysock/tpcds/GenTable.java:120-141).
+The TPU analog is JAX's multi-controller runtime: one Python process per
+host, federated through ``jax.distributed.initialize`` — after which
+``jax.devices()`` spans every host, a ``Mesh`` over it makes GSPMD insert
+ICI collectives within a slice and DCN collectives across slices, and the
+whole engine (including the exchange join, parallel/exchange.py) runs
+unchanged over the global mesh.
+
+Environment contract (exported by the launch templates, base.template):
+
+    NDS_TPU_MULTIHOST=1         opt in (or auto: set on TPU pod slices)
+    NDS_COORDINATOR=host:port   coordinator (omit on TPU pods: auto-detect)
+    NDS_NUM_PROCESSES=N         process count (omit on TPU pods)
+    NDS_PROCESS_ID=i            this process's id (omit on TPU pods)
+
+On Cloud TPU pods all three specifics auto-detect from the metadata
+server, so ``NDS_TPU_MULTIHOST=1`` alone is sufficient there.
+
+Like the reference — whose multi-node behavior is only ever exercised on a
+real cluster (SURVEY.md §4) — the federation itself needs real hosts; CI
+covers the plumbing (env parsing, idempotence, host-shard arithmetic) and
+the single-process mesh path.
+"""
+
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def maybe_initialize() -> bool:
+    """Idemptotently initialize the multi-controller runtime when the
+    environment opts in. Returns True when running multi-host (after
+    successful initialization), False in single-process mode.
+
+    Called from Session construction and the driver CLIs before any
+    device query — ``jax.distributed.initialize`` must precede backend
+    initialization.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    if not os.environ.get("NDS_TPU_MULTIHOST"):
+        return False
+    import jax
+    kwargs = {}
+    if os.environ.get("NDS_COORDINATOR"):
+        kwargs["coordinator_address"] = os.environ["NDS_COORDINATOR"]
+    if os.environ.get("NDS_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(os.environ["NDS_NUM_PROCESSES"])
+    if os.environ.get("NDS_PROCESS_ID"):
+        kwargs["process_id"] = int(os.environ["NDS_PROCESS_ID"])
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    return True
+
+
+def process_info():
+    """(process_index, process_count) — (0, 1) before/without init."""
+    import jax
+    try:
+        return jax.process_index(), jax.process_count()
+    except RuntimeError:  # backend not initialized yet
+        return 0, 1
+
+
+def host_shard_range(n: int, process_index: int | None = None,
+                     process_count: int | None = None) -> tuple[int, int]:
+    """[start, end) of the rows/chunks this host owns out of ``n`` — the
+    per-host split used by data loading and generation so each process
+    feeds only its local devices (the MR wrapper's one-command-per-mapper
+    split, re-expressed; ref: GenTable.java:140-141)."""
+    if process_index is None or process_count is None:
+        process_index, process_count = process_info()
+    per = (n + process_count - 1) // process_count
+    start = min(process_index * per, n)
+    return start, min(start + per, n)

@@ -1,0 +1,62 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Multi-host plumbing (nds_tpu/parallel/multihost.py). Real federation
+needs real hosts (SURVEY.md §4: the reference's multi-node behavior is
+likewise cluster-only); CI covers env parsing, idempotence, and the
+per-host shard arithmetic every loader keys on."""
+
+import pytest
+
+from nds_tpu.parallel import multihost as M
+
+
+@pytest.fixture(autouse=True)
+def reset_state(monkeypatch):
+    monkeypatch.setattr(M, "_initialized", False)
+
+
+def test_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("NDS_TPU_MULTIHOST", raising=False)
+    assert M.maybe_initialize() is False
+
+
+def test_initialize_passes_env_contract(monkeypatch):
+    calls = {}
+    monkeypatch.setenv("NDS_TPU_MULTIHOST", "1")
+    monkeypatch.setenv("NDS_COORDINATOR", "10.0.0.2:8476")
+    monkeypatch.setenv("NDS_NUM_PROCESSES", "4")
+    monkeypatch.setenv("NDS_PROCESS_ID", "3")
+    import jax
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.update(kw))
+    assert M.maybe_initialize() is True
+    assert calls == {"coordinator_address": "10.0.0.2:8476",
+                     "num_processes": 4, "process_id": 3}
+    # idempotent: a second call must not re-initialize
+    calls.clear()
+    assert M.maybe_initialize() is True
+    assert calls == {}
+
+
+def test_pod_autodetect_passes_no_kwargs(monkeypatch):
+    """On TPU pods everything auto-detects: only the opt-in is set."""
+    calls = []
+    monkeypatch.setenv("NDS_TPU_MULTIHOST", "1")
+    for var in ("NDS_COORDINATOR", "NDS_NUM_PROCESSES", "NDS_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    import jax
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    assert M.maybe_initialize() is True
+    assert calls == [{}]
+
+
+def test_host_shard_range_partitions_exactly():
+    n = 103
+    spans = [M.host_shard_range(n, i, 4) for i in range(4)]
+    assert spans[0][0] == 0 and spans[-1][1] == n
+    covered = []
+    for s, e in spans:
+        covered.extend(range(s, e))
+    assert covered == list(range(n))
+    # single-process degenerate case covers everything
+    assert M.host_shard_range(n, 0, 1) == (0, n)

@@ -1,0 +1,93 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Spec-toolkit patch flow (ref: nds/tpcds-gen/Makefile:18-43,
+patches/code.patch). The patch functions are pure source rewrites, so they
+are testable without the (user-supplied) toolkit; the end-to-end build/run
+test engages only when $TPCDS_HOME is set."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tools.tpcds_toolkit import (  # noqa: E402
+    MARKER, patch_print_c, patch_r_params_c, prepare)
+
+PRINT_C = """
+int
+print_close(int tbl)
+{
+\ttdef *pTdef = getSimpleTdefsByNumber(tbl);
+\tfpOutfile = NULL;
+\tif (pTdef->outfile)
+\t{
+\t\tfclose(pTdef->outfile);
+\t\tpTdef->outfile = NULL;
+\t}
+}
+
+int
+print_end (int tbl)
+{
+   if (add_term)
+      fwrite(term, 1, add_term, fpOutfile);
+   fprintf (fpOutfile, "\\n");
+   fflush(fpOutfile);
+
+   return (res);
+}
+"""
+
+R_PARAMS_C = """
+#define PARAM_MAX_LEN\t80
+
+void set_str(char *var, char *val)
+{
+\tnParam = fnd_param(var);
+\tif (nParam >= 0)
+\t{
+\t\tstrcpy(params[options[nParam].index], val);
+\t\toptions[nParam].flags |= OPT_SET;
+\t}
+}
+"""
+
+
+def test_patch_print_c_adds_close_flush_and_drops_row_flush():
+    out = patch_print_c(PRINT_C)
+    # close-time flush inserted directly before the fclose
+    i_flush = out.index("fflush(pTdef->outfile)")
+    i_close = out.index("fclose(pTdef->outfile)")
+    assert i_flush < i_close
+    # the per-row flush is disabled but left visible
+    assert "/* fflush(fpOutfile); */" in out
+    assert out.count(MARKER) == 2
+    # idempotent
+    assert patch_print_c(out) == out
+
+
+def test_patch_r_params_widens_param_len_and_bounds_copy():
+    out = patch_r_params_c(R_PARAMS_C)
+    assert "PARAM_MAX_LEN\tPATH_MAX" in out
+    assert "strncpy(params[options[nParam].index], val, PARAM_MAX_LEN)" in out
+    assert "strcpy(params[options[nParam].index], val);" not in out
+    assert patch_r_params_c(out) == out
+
+
+@pytest.mark.skipif(not os.environ.get("TPCDS_HOME"),
+                    reason="spec toolkit not supplied ($TPCDS_HOME unset)")
+def test_toolkit_end_to_end(tmp_path):
+    """With a real toolkit: patch, build, and generate one tiny table chunk
+    through the same driver surface the reference uses."""
+    dsdgen = prepare(os.environ["TPCDS_HOME"])
+    out = tmp_path / "raw"
+    out.mkdir()
+    subprocess.run(
+        [str(dsdgen), "-scale", "1", "-dir", str(out), "-table",
+         "call_center", "-force", "Y"],
+        cwd=os.path.dirname(dsdgen), check=True)
+    files = list(out.glob("call_center*"))
+    assert files and files[0].stat().st_size > 0

@@ -1,0 +1,134 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Patch-and-build flow for the spec TPC-DS toolkit (dsdgen/dsqgen).
+
+Bit-parity with reference-generated data requires the spec's own C
+generator — SURVEY.md §2.2 N1 explicitly warns against substituting its
+RNG. The reference patches the user-supplied TPC-DS v3.2.0 toolkit before
+building (ref: nds/tpcds-gen/Makefile:18-43, patches/code.patch), fixing:
+
+1. ``tools/print.c print_close``: output files are closed without a final
+   flush when dsdgen runs embedded/parallel — add ``fflush`` before
+   ``fclose`` so the last block always lands.
+2. ``tools/print.c print_end``: drop the per-row ``fflush`` (it serializes
+   every row write; the close-time flush above makes it redundant).
+3. ``tools/r_params.c``: ``PARAM_MAX_LEN`` is 80, truncating long ``-dir``
+   paths — raise it to ``PATH_MAX`` and bound the ``strcpy`` with
+   ``strncpy``.
+
+This tool applies the same fixes as idempotent source rewrites (re-derived,
+not a copy of the reference patch file) and builds the tools, giving
+``nds_gen_data.py`` a working ``$TPCDS_HOME/tools/dsdgen``:
+
+    export TPCDS_HOME=/path/to/DSGen-software-code-3.2.0rc1
+    python tools/tpcds_toolkit.py prepare     # patch + make
+    python nds_gen_data.py local 1 8 /data/raw_sf1
+
+The reference also patches the query templates for the Spark dialect
+(patches/templates.patch). This framework ships its own native template
+corpus (nds_tpu/queries/templates), so template patching is not needed for
+data parity; dsqgen-generated streams remain available for cross-checking
+by pointing ``nds_gen_query_stream.py`` at a patched template dir.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+MARKER = "/* nds-tpu toolkit patch */"
+
+
+def patch_print_c(src: str) -> str:
+    """Apply fixes 1 and 2 to a ``tools/print.c`` source string."""
+    if MARKER in src:
+        return src
+    out = []
+    lines = src.splitlines(keepends=True)
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        # fix 1: flush before the close inside print_close's outfile branch
+        if "fclose(pTdef->outfile)" in line and \
+                (not out or "fflush(pTdef->outfile)" not in out[-1]):
+            indent = line[:len(line) - len(line.lstrip())]
+            out.append(f"{indent}fflush(pTdef->outfile); {MARKER}\n")
+            out.append(line)
+            i += 1
+            continue
+        # fix 2: drop the per-row flush in print_end (keep line for diffs)
+        stripped = line.strip()
+        if stripped == "fflush(fpOutfile);":
+            indent = line[:len(line) - len(line.lstrip())]
+            out.append(f"{indent}/* fflush(fpOutfile); */ {MARKER}\n")
+            i += 1
+            continue
+        out.append(line)
+        i += 1
+    return "".join(out)
+
+
+def patch_r_params_c(src: str) -> str:
+    """Apply fix 3 to a ``tools/r_params.c`` source string."""
+    if MARKER in src:
+        return src
+    src = src.replace(
+        "#define PARAM_MAX_LEN\t80",
+        f"#define PARAM_MAX_LEN\tPATH_MAX {MARKER}")
+    src = src.replace(
+        "#define PARAM_MAX_LEN 80",
+        f"#define PARAM_MAX_LEN PATH_MAX {MARKER}")
+    src = src.replace(
+        "strcpy(params[options[nParam].index], val);",
+        f"strncpy(params[options[nParam].index], val, "
+        f"PARAM_MAX_LEN); {MARKER}")
+    return src
+
+
+def prepare(tpcds_home: str, build: bool = True) -> Path:
+    """Patch the toolkit sources in place (idempotent) and build."""
+    tools = Path(tpcds_home) / "tools"
+    if not tools.is_dir():
+        raise SystemExit(f"no tools/ under TPCDS_HOME={tpcds_home}")
+    for name, fn in (("print.c", patch_print_c),
+                     ("r_params.c", patch_r_params_c)):
+        p = tools / name
+        src = p.read_text(encoding="ISO-8859-1")
+        patched = fn(src)
+        if patched != src:
+            p.write_text(patched, encoding="ISO-8859-1")
+            print(f"patched {p}")
+        else:
+            print(f"already patched: {p}")
+    if build:
+        # the toolkit's Makefile defaults are fine on linux; -fcommon is
+        # required with modern gcc (duplicate tentative definitions,
+        # ref: nds/README.md:84-96)
+        env = dict(os.environ)
+        env.setdefault("CFLAGS", "-fcommon")
+        subprocess.run(["make", "clean"], cwd=tools, env=env,
+                       capture_output=True)
+        subprocess.run(["make"], cwd=tools, env=env, check=True)
+        dsdgen = tools / "dsdgen"
+        if not dsdgen.is_file():
+            raise SystemExit("build finished but tools/dsdgen is missing")
+        print(f"built {dsdgen}")
+        return dsdgen
+    return tools / "dsdgen"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    prep = sub.add_parser("prepare", help="patch $TPCDS_HOME and build")
+    prep.add_argument("--no-build", action="store_true")
+    args = ap.parse_args()
+    home = os.environ.get("TPCDS_HOME")
+    if not home:
+        raise SystemExit("set $TPCDS_HOME to the TPC-DS v3.2.0 toolkit dir")
+    if args.cmd == "prepare":
+        prepare(home, build=not args.no_build)
+
+
+if __name__ == "__main__":
+    main()
