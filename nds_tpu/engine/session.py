@@ -137,10 +137,14 @@ class Session:
         (raw/columnar/warehouse readers), which lets the planner trust
         schema facts like primary-key uniqueness; any re-registration under
         the same name through a non-base path revokes the marker."""
+        from nds_tpu.engine.table import ChunkedTable
         if isinstance(table, pa.Table):
             table = from_arrow(table)
         key = name.lower()
-        self.catalog[key] = self._shard_table(table)
+        if isinstance(table, ChunkedTable):
+            self.catalog[key] = table        # host-resident; never sharded
+        else:
+            self.catalog[key] = self._shard_table(table)
         if base:
             self.base_tables.add(key)
         else:
@@ -159,11 +163,25 @@ class Session:
 
     def read_columnar_view(self, name: str, path: str, fmt: str = "parquet",
                            canonical_types: dict | None = None) -> float:
+        import os
+
+        from nds_tpu.engine.table import ChunkedTable
         from nds_tpu.io import read_table
         start = time.perf_counter()
         arrow = read_table(path, fmt)
-        self.create_temp_view(name, from_arrow(arrow, canonical_types),
-                              base=True)
+        # >HBM streaming decision: a table past the stream threshold stays
+        # host-resident and is bound chunk-by-chunk by the planner (the
+        # role of Spark's file splits; SURVEY.md §5.7). A meshed session
+        # row-shards instead — the mesh multiplies device capacity.
+        limit = int(self.conf.get(
+            "stream_bytes",
+            os.environ.get("NDS_TPU_STREAM_BYTES", str(8 << 30))))
+        if self.mesh is None and arrow.nbytes > limit:
+            self.create_temp_view(
+                name, ChunkedTable(arrow, canonical_types), base=True)
+        else:
+            self.create_temp_view(name, from_arrow(arrow, canonical_types),
+                                  base=True)
         return time.perf_counter() - start
 
     # -- SQL ----------------------------------------------------------------

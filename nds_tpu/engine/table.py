@@ -78,3 +78,65 @@ class DeviceTable:
     def __repr__(self):
         cols = ", ".join(f"{n}:{c.kind}" for n, c in self.columns.items())
         return f"DeviceTable[{self.nrows}/{self.plen} rows]({cols})"
+
+
+class ChunkedTable:
+    """A host-resident (arrow) table streamed through queries in row
+    chunks — the scan path for tables larger than device HBM (SURVEY.md
+    §5.7: "operators must stream/partition tables larger than HBM", the
+    structural place sequence parallelism occupies in a model framework;
+    the reference's analog is Spark file splits +
+    spark.sql.files.maxPartitionBytes, ref: nds/power_run_gpu.template:30).
+
+    The planner binds each device chunk in turn and runs the normal join
+    graph per chunk (filters and joins shrink the chunk before anything is
+    kept), concatenating the surviving rows; aggregation runs downstream on
+    the union, so no operator ever sees the whole table on device. Chunk
+    row counts are a fixed power of two, so every full chunk reuses the
+    same XLA executables.
+    """
+
+    def __init__(self, arrow, canonical_types: dict | None = None,
+                 chunk_rows: int | None = None):
+        import os
+        self.arrow = arrow
+        self.canonical_types = canonical_types or {}
+        self.chunk_rows = int(chunk_rows or os.environ.get(
+            "NDS_TPU_STREAM_CHUNK_ROWS", str(1 << 22)))
+
+    @property
+    def nrows(self) -> int:
+        return self.arrow.num_rows
+
+    @property
+    def nbytes(self) -> int:
+        return self.arrow.nbytes
+
+    @property
+    def column_names(self):
+        return list(self.arrow.column_names)
+
+    def select(self, names) -> "ChunkedTable":
+        return ChunkedTable(self.arrow.select(names), self.canonical_types,
+                            self.chunk_rows)
+
+    def device_chunks(self):
+        """Yield DeviceTable chunks (at least one, possibly empty, so the
+        schema always survives to the consumer)."""
+        from nds_tpu.engine.column import from_arrow
+        n = self.arrow.num_rows
+        if n == 0:
+            yield from_arrow(self.arrow, self.canonical_types)
+            return
+        for s in range(0, n, self.chunk_rows):
+            sl = self.arrow.slice(s, min(self.chunk_rows, n - s))
+            yield from_arrow(sl.combine_chunks(), self.canonical_types)
+
+    def materialize(self) -> DeviceTable:
+        from nds_tpu.engine.column import from_arrow
+        return from_arrow(self.arrow, self.canonical_types)
+
+    def __repr__(self):
+        return (f"ChunkedTable[{self.nrows} rows x "
+                f"{len(self.arrow.column_names)} cols, "
+                f"chunk={self.chunk_rows}]")

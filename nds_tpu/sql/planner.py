@@ -66,6 +66,32 @@ def _nrows(ctx: EvalCtx) -> int:
     return ctx.table.nrows
 
 
+class _StreamedScan:
+    """A >HBM base-table scan inside a join graph: the host-resident
+    ChunkedTable plus its FROM alias. :func:`Planner._stream_join_parts`
+    binds its device chunks one at a time."""
+
+    def __init__(self, chunked, alias: str):
+        self.chunked = chunked
+        self.alias = alias
+
+    @property
+    def nbytes(self) -> int:
+        return self.chunked.nbytes
+
+    @property
+    def column_names(self):
+        return [f"{self.alias.lower()}.{n.split('.')[-1].lower()}"
+                for n in self.chunked.column_names]
+
+    def device_chunks(self, planner):
+        for chunk in self.chunked.device_chunks():
+            yield planner._alias_table(chunk, self.alias)
+
+    def bind_whole(self, planner):
+        return planner._alias_table(self.chunked.materialize(), self.alias)
+
+
 class Planner:
     def __init__(self, catalog: dict, base_tables: set | None = None):
         self.catalog = catalog          # name -> (DeviceTable with plain col names)
@@ -260,7 +286,20 @@ class Planner:
             # table — its rows carry no schema uniqueness guarantees
             in_cte = any(name_l in scope for scope in self.cte_stack)
             is_base = not in_cte and name_l in self.base_tables
-            t = self._alias_table(self._lookup_table(from_.name), alias)
+            raw = self._lookup_table(from_.name)
+            from nds_tpu.engine.table import ChunkedTable
+            if isinstance(raw, ChunkedTable):
+                # >HBM scan: stays host-resident; _join_parts binds device
+                # chunks one at a time. Projection pushdown prunes the
+                # arrow columns, so only referenced bytes ever upload.
+                if self._needed_names is not None:
+                    keep = [n for n in raw.column_names
+                            if n.lower() in self._needed_names]
+                    if keep and len(keep) < len(raw.column_names):
+                        raw = raw.select(keep)
+                part = _StreamedScan(raw, alias)
+                return [part], [], [name_l if is_base else None]
+            t = self._alias_table(raw, alias)
             if self._needed_names is not None:
                 # projection pushdown: drop scan columns nothing in the
                 # statement references (fact tables are 20+ columns wide,
@@ -831,6 +870,32 @@ class Planner:
             return table
         return E.compact_table(table, self._conjunct_mask(table, conjuncts))
 
+    def _stream_join_parts(self, parts, join_preds, where_conjuncts,
+                           sources):
+        """Streamed execution of a join graph containing >HBM scans: bind
+        the largest streamed part's device chunks one at a time, run the
+        NORMAL join graph per chunk (pushed-down filters and joins shrink
+        the chunk before anything is kept), and concatenate the survivors.
+        Downstream aggregation runs on the union, which is correct because
+        joins and filters distribute over row-wise union. Other streamed
+        parts materialize whole (one streaming axis per graph)."""
+        streamed = [i for i, p in enumerate(parts)
+                    if isinstance(p, _StreamedScan)]
+        keep = max(streamed, key=lambda i: parts[i].nbytes)
+        parts = list(parts)
+        for i in streamed:
+            if i != keep:
+                parts[i] = parts[i].bind_whole(self)
+        outs = []
+        for chunk in parts[keep].device_chunks(self):
+            sub = list(parts)
+            sub[keep] = chunk
+            out = self._join_parts(sub, join_preds, where_conjuncts,
+                                   list(sources))
+            if out.nrows or not outs:
+                outs.append(out)
+        return E.concat_tables(outs) if len(outs) > 1 else outs[0]
+
     def _join_parts(self, parts, join_preds, where_conjuncts, sources=None):
         """Join-graph execution: push single-table predicates down, then join
         parts connected by equi edges, deferring unconnected parts
@@ -841,6 +906,9 @@ class Planner:
         star-join shape that dominates the TPC-DS corpus."""
         if sources is None:
             sources = [None] * len(parts)
+        if any(isinstance(p, _StreamedScan) for p in parts):
+            return self._stream_join_parts(parts, join_preds,
+                                           where_conjuncts, sources)
         sources = list(sources)
         conjuncts = list(join_preds) + list(where_conjuncts)
         # split into single-table filters / equi edges / complex residual

@@ -1,0 +1,102 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+""">HBM streaming scans (ChunkedTable): queries over a host-resident,
+chunk-bound fact table must match the fully device-resident results —
+SURVEY.md §5.7's structural requirement (tables larger than HBM stream
+through the operators)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine.session import Session
+from nds_tpu.engine.table import ChunkedTable
+
+
+def _tables(n=5000):
+    rng = np.random.default_rng(21)
+    sales = pa.table({
+        "s_item": pa.array(rng.integers(1, 80, n), pa.int64()),
+        "s_date": pa.array(rng.integers(1, 300, n), pa.int64()),
+        "s_qty": pa.array(rng.integers(1, 50, n), pa.int64()),
+        "s_price": pa.array([None if x % 13 == 0 else int(x)
+                             for x in rng.integers(1, 9000, n)], pa.int64()),
+        "s_tag": pa.array(rng.choice(["a", "b", "c", None], n)),
+    })
+    items = pa.table({
+        "i_item": pa.array(np.arange(1, 81), pa.int64()),
+        "i_cat": pa.array([f"cat{k % 7}" for k in range(80)]),
+    })
+    dates = pa.table({
+        "d_date": pa.array(np.arange(1, 301), pa.int64()),
+        "d_year": pa.array(1998 + np.arange(300) // 100, pa.int64()),
+    })
+    return sales, items, dates
+
+
+CASES = [
+    # star join + group + order (the flagship shape)
+    """select d_year, i_cat, sum(s_qty) q, count(*) c, avg(s_price)
+       from sales, items, dates
+       where s_item = i_item and s_date = d_date and s_qty > 5
+       group by d_year, i_cat order by d_year, i_cat""",
+    # direct filter + projection on the streamed table only
+    """select s_item, s_qty from sales where s_qty > 47 and s_tag = 'b'
+       order by s_item, s_qty""",
+    # distinct + semi-join against the streamed fact
+    """select distinct s_tag from sales
+       where s_item in (select i_item from items where i_cat = 'cat2')
+       order by s_tag""",
+    # window over the streamed join output
+    """select i_cat, s_qty, rank() over (partition by i_cat
+       order by s_qty desc, s_item) r
+       from sales, items where s_item = i_item and s_qty > 45
+       order by i_cat, r limit 40""",
+]
+
+
+@pytest.mark.parametrize("case", range(len(CASES)))
+def test_streamed_scan_matches_resident(case):
+    sales, items, dates = _tables()
+    resident = Session()
+    streamed = Session()
+    for s, kind in ((resident, "resident"), (streamed, "streamed")):
+        s.create_temp_view("items", items, base=True)
+        s.create_temp_view("dates", dates, base=True)
+    resident.create_temp_view("sales", sales, base=True)
+    # 7 chunks of 800 rows exercise partial-trailing-chunk bucketing too
+    streamed.create_temp_view("sales", ChunkedTable(sales, chunk_rows=800),
+                              base=True)
+    a = resident.sql(CASES[case]).collect()
+    b = streamed.sql(CASES[case]).collect()
+    assert a == b
+
+
+def test_two_streamed_tables_one_axis():
+    """With two streamed parts, one streams and the other materializes —
+    results still exact."""
+    sales, items, dates = _tables(2000)
+    resident = Session()
+    streamed = Session()
+    resident.create_temp_view("sales", sales, base=True)
+    resident.create_temp_view("items", items, base=True)
+    streamed.create_temp_view("sales", ChunkedTable(sales, chunk_rows=512),
+                              base=True)
+    streamed.create_temp_view("items", ChunkedTable(items, chunk_rows=32),
+                              base=True)
+    sql = ("select i_cat, sum(s_qty) q from sales, items "
+           "where s_item = i_item group by i_cat order by i_cat")
+    assert resident.sql(sql).collect() == streamed.sql(sql).collect()
+
+
+def test_session_stream_threshold(monkeypatch, tmp_path):
+    """read_columnar_view streams tables past the byte threshold."""
+    import pyarrow.parquet as pq
+    sales, _, _ = _tables(3000)
+    p = tmp_path / "sales.parquet"
+    pq.write_table(sales, p)
+    monkeypatch.setenv("NDS_TPU_STREAM_BYTES", "1024")
+    s = Session()
+    s.read_columnar_view("sales", str(p))
+    assert isinstance(s.catalog["sales"], ChunkedTable)
+    r = s.sql("select count(*), sum(s_qty) from sales").collect()
+    assert r[0][0] == 3000
