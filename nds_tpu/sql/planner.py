@@ -1165,55 +1165,42 @@ class Planner:
         key_cols = [self.eval_expr(e, base_ctx) for e in group_exprs]
         key_names = [expr_key(e) for e in group_exprs]
 
-        set_tables = []
-        for gset in group_by.sets:
-            gset_keys = [expr_key(e) for e in gset]
-            active = [key_cols[i] for i, k in enumerate(key_names) if k in gset_keys]
-            if table.nrows == 0:
-                # empty input: global agg still yields one row
-                if active or group_by.kind != "plain" or group_exprs:
-                    continue
-            if active:
-                gids, ng, rep, cap = E.group_ids(active, n_valid=table.nrows)
-            else:
-                # global aggregate: live rows in group 0, pads in a dropped
-                # trailing slot
-                ng, cap = 1, E.bucket_len(1)
-                gids = jnp.where(E.live_mask(table.plen, table.nrows),
-                                 0, cap).astype(jnp.int64)
-                rep = jnp.zeros(cap, dtype=jnp.int64)
-            post = EvalCtx(DeviceTable({}, ng, plen=cap), post_agg=True)
-            # group key columns (taken at representatives); inactive keys null
-            for i, (kname, kcol) in enumerate(zip(key_names, key_cols)):
-                if kname in gset_keys:
-                    post.group_values[kname] = kcol.take(rep) if table.nrows else \
-                        X.literal(None, cap)
-                    post.grouping_flags[kname] = 0
+        set_tables = self._rollup_fast(sel, group_by, agg_calls, base_ctx,
+                                       key_cols, key_names, table)
+        if set_tables is not None:
+            pass
+        else:
+            set_tables = []
+            for gset in group_by.sets:
+                gset_keys = [expr_key(e) for e in gset]
+                active = [key_cols[i] for i, k in enumerate(key_names)
+                          if k in gset_keys]
+                if table.nrows == 0:
+                    # empty input: global agg still yields one row
+                    if active or group_by.kind != "plain" or group_exprs:
+                        continue
+                if active:
+                    gids, ng, rep, cap = E.group_ids(active,
+                                                     n_valid=table.nrows)
                 else:
-                    null = X.literal(None, cap)
-                    if kcol.kind == "str":
-                        null = Column("str", jnp.zeros(cap, dtype=jnp.int32),
-                                      jnp.zeros(cap, dtype=bool), kcol.dict_values)
-                    else:
-                        null = Column(kcol.kind,
-                                      jnp.zeros(cap, dtype=kcol.data.dtype),
-                                      jnp.zeros(cap, dtype=bool), kcol.dict_values)
-                    post.group_values[kname] = null
-                    post.grouping_flags[kname] = 1
-            # aggregates (segment capacity = cap keeps shapes canonical; pad
-            # contributions land past ng or are dropped)
-            for akey, call in agg_calls.items():
-                post.agg_values[akey] = self._compute_agg(call, base_ctx, gids,
-                                                          cap, active)
-            post.table = DeviceTable({}, ng, plen=cap)
-            # HAVING before projection
-            if sel.having is not None:
-                mask_col = self.eval_expr(sel.having, post)
-                post = self._mask_ctx(
-                    post, mask_col.data.astype(bool) & mask_col.valid_mask())
-            self._eval_windows(sel, post)
-            out = self._project(sel, post)
-            set_tables.append((out, post))
+                    # global aggregate: live rows in group 0, pads in a
+                    # dropped trailing slot
+                    ng, cap = 1, E.bucket_len(1)
+                    gids = jnp.where(E.live_mask(table.plen, table.nrows),
+                                     0, cap).astype(jnp.int64)
+                    rep = jnp.zeros(cap, dtype=jnp.int64)
+                group_cols = {
+                    k: (key_cols[i].take(rep) if table.nrows
+                        else X.literal(None, cap))
+                    for i, k in enumerate(key_names) if k in gset_keys}
+                # aggregates (segment capacity = cap keeps shapes canonical;
+                # pad contributions land past ng or are dropped)
+                agg_vals = {akey: self._compute_agg(call, base_ctx, gids,
+                                                    cap, active)
+                            for akey, call in agg_calls.items()}
+                set_tables.append(self._finish_set(
+                    sel, set(gset_keys), key_names, key_cols, group_cols,
+                    agg_vals, ng, cap))
         if not set_tables:
             # grouped query over empty input -> empty result with right
             # names. Keep the physical floor bucket (plen >= 16, nrows = 0):
@@ -1238,6 +1225,139 @@ class Planner:
             return set_tables[0]
         tables = [t for t, _ in set_tables]
         return E.concat_tables(tables), set_tables[0][1]
+
+    def _finish_set(self, sel: A.Select, gset_keys: set, key_names, key_cols,
+                    group_cols: dict, agg_vals: dict, ng: int, cap: int):
+        """Build one grouping set's output: post-agg context (active keys
+        from ``group_cols``, inactive keys as typed nulls, grouping flags),
+        HAVING, windows, projection."""
+        post = EvalCtx(DeviceTable({}, ng, plen=cap), post_agg=True)
+        for kname, kcol in zip(key_names, key_cols):
+            if kname in gset_keys:
+                post.group_values[kname] = group_cols[kname]
+                post.grouping_flags[kname] = 0
+            else:
+                if kcol.kind == "str":
+                    null = Column("str", jnp.zeros(cap, dtype=jnp.int32),
+                                  jnp.zeros(cap, dtype=bool),
+                                  kcol.dict_values)
+                else:
+                    null = Column(kcol.kind,
+                                  jnp.zeros(cap, dtype=kcol.data.dtype),
+                                  jnp.zeros(cap, dtype=bool),
+                                  kcol.dict_values)
+                post.group_values[kname] = null
+                post.grouping_flags[kname] = 1
+        post.agg_values.update(agg_vals)
+        post.table = DeviceTable({}, ng, plen=cap)
+        # HAVING before projection
+        if sel.having is not None:
+            mask_col = self.eval_expr(sel.having, post)
+            post = self._mask_ctx(
+                post, mask_col.data.astype(bool) & mask_col.valid_mask())
+        self._eval_windows(sel, post)
+        out = self._project(sel, post)
+        return out, post
+
+    _ROLLUP_REAGG = {"sum", "count", "avg", "min", "max"}
+
+    def _rollup_fast(self, sel, group_by, agg_calls, base_ctx, key_cols,
+                     key_names, table):
+        """Hierarchical ROLLUP: grouping sets are prefixes of one another
+        (finest first), so each coarser level re-aggregates the PREVIOUS
+        level's partial aggregates (thousands of groups) instead of
+        re-grouping the base table (millions of rows) — the rollup twin of
+        partial/final aggregation. Engages when every aggregate is
+        algebraically decomposable (sum/count/avg/min/max, no DISTINCT);
+        returns None to fall back to the per-set generic path."""
+        if group_by.kind != "rollup" or table.nrows == 0:
+            return None
+        if not agg_calls or not all(
+                c.name in self._ROLLUP_REAGG and not c.distinct
+                for c in agg_calls.values()):
+            return None
+        expected = [[expr_key(e) for e in s] for s in group_by.sets]
+        if any(ks != key_names[:len(ks)] for ks in expected) or \
+                not expected or not expected[0]:
+            return None
+        set_tables = []
+        prev = None          # (level key Columns, partials, ng, cap)
+        for gkeys in expected:
+            k = len(gkeys)
+            if prev is None:
+                gids, ng, rep, cap = E.group_ids(key_cols[:k],
+                                                 n_valid=table.nrows)
+                lvl_keys = [c.take(rep) for c in key_cols[:k]]
+                partials = {akey: self._agg_partials(call, base_ctx, gids,
+                                                     cap)
+                            for akey, call in agg_calls.items()}
+            else:
+                p_keys, p_partials, p_ng, p_cap = prev
+                if k:
+                    gids, ng, rep, cap = E.group_ids(p_keys[:k], n_valid=p_ng)
+                    lvl_keys = [c.take(rep) for c in p_keys[:k]]
+                else:
+                    ng, cap = 1, E.bucket_len(1)
+                    gids = jnp.where(E.live_mask(p_cap, p_ng), 0,
+                                     cap).astype(jnp.int64)
+                    lvl_keys = []
+                partials = {akey: self._reagg_partials(p, gids, cap)
+                            for akey, p in p_partials.items()}
+            agg_vals = {akey: self._finalize_partial(call, partials[akey])
+                        for akey, call in agg_calls.items()}
+            group_cols = dict(zip(gkeys, lvl_keys))
+            set_tables.append(self._finish_set(
+                sel, set(gkeys), key_names, key_cols, group_cols, agg_vals,
+                ng, cap))
+            prev = (lvl_keys, partials, ng, cap)
+        return set_tables
+
+    def _agg_partials(self, call: A.FuncCall, base_ctx: EvalCtx, gids, cap):
+        """Decomposed (re-aggregatable) components of one aggregate at the
+        finest rollup level."""
+        arg = self.eval_expr(call.args[0], base_ctx) if call.args else None
+        n = call.name
+        if n == "count":
+            return {"count": self._as_plain_count(
+                E.agg_count(arg, gids, cap))}
+        if n == "sum":
+            return {"sum": E.agg_sum(arg, gids, cap)}
+        if n == "avg":
+            return {"sum": E.agg_sum(arg, gids, cap),
+                    "count": self._as_plain_count(
+                        E.agg_count(arg, gids, cap))}
+        return {n: E.agg_min(arg, gids, cap, is_max=(n == "max"))}
+
+    @staticmethod
+    def _as_plain_count(col: Column) -> Column:
+        # COUNT is never NULL: empty slots are zero, not invalid
+        return Column(col.kind, col.data, None)
+
+    def _reagg_partials(self, partials: dict, gids, cap):
+        out = {}
+        for part, col in partials.items():
+            if part == "count":
+                s = E.agg_sum(col, gids, cap)
+                out[part] = Column(col.kind, s.data, None)
+            elif part == "sum":
+                out[part] = E.agg_sum(col, gids, cap)
+            else:                                    # "min" / "max"
+                out[part] = E.agg_min(col, gids, cap,
+                                      is_max=(part == "max"))
+        return out
+
+    def _finalize_partial(self, call: A.FuncCall, partials: dict) -> Column:
+        n = call.name
+        if n in ("count", "sum", "min", "max"):
+            return partials[n]
+        # avg = sum / count with the decimal descale agg_avg applies
+        s, c = partials["sum"], partials["count"]
+        data = s.data.astype(jnp.float64)
+        if s.scale:
+            data = data / (10.0 ** s.scale)
+        cnt = c.data.astype(jnp.float64)
+        out = jnp.where(cnt > 0, data / jnp.maximum(cnt, 1.0), 0.0)
+        return Column("f64", out, c.data > 0)
 
     def _mask_ctx(self, ctx: EvalCtx, mask) -> EvalCtx:
         """Compact an aggregation context by a boolean mask (HAVING)."""
