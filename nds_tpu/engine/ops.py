@@ -781,14 +781,80 @@ def _pk_gather_impl(fkey, fvalid, dkey, dvalid, n_fact, n_dim,
     return jnp.take(order, lo), matched
 
 
+_dense_dim_cache: dict = {}
+
+
+def _dense_dim_info(dim_key: Column, n_dim: int):
+    """(base, device position map) when the dimension key is a dense-ish
+    unique integer range (every TPC-DS surrogate key is), else None.
+    Cached per key-array identity — built once per loaded dimension, it
+    replaces the per-join searchsorted (a 17-iteration binary-search loop
+    over emulated int64, ~0.6s for a 4M-row probe on v5e) with ONE gather."""
+    if dim_key.kind == "str" or n_dim == 0 or n_dim > (1 << 24):
+        return None
+
+    def compute():
+        live = np.asarray(dim_key.data[:n_dim]).astype(np.int64)
+        if dim_key.valid is not None and \
+                not bool(np.all(np.asarray(dim_key.valid[:n_dim]))):
+            return None                       # null PKs: sort path handles
+        mn = int(live.min())
+        span = int(live.max()) - mn + 1
+        # sparse keys would blow the map; 4x slack covers SCD-style gaps
+        if span > max(4 * n_dim, 1 << 16) or span > (1 << 26):
+            return None
+        pos = np.full(span, n_dim, dtype=np.int64)   # n_dim = miss marker
+        pos[live - mn] = np.arange(n_dim)
+        return mn, jnp.asarray(pos)
+
+    return _identity_cache(_dense_dim_cache, 64, (dim_key.data,), compute)
+
+
+@jax.jit
+def _pk_gather_dense_impl(fkey, fvalid, dkey, dvalid, pos_map, base,
+                          n_fact, n_dim, f_excl, d_excl):
+    """Dense-range merge probe: position-map gather instead of sort +
+    searchsorted. Same contract as :func:`_pk_gather_impl`."""
+    plen_d = dkey.shape[0]
+    plen_f = fkey.shape[0]
+    ok_d = jnp.arange(plen_d) < n_dim
+    if dvalid is not None:
+        ok_d = ok_d & dvalid
+    if d_excl is not None:
+        ok_d = ok_d & ~d_excl
+    fk = fkey.astype(jnp.int64)
+    off = fk - base
+    span = pos_map.shape[0]
+    inb = (off >= 0) & (off < span)
+    r_idx = jnp.take(pos_map, jnp.clip(off, 0, span - 1))
+    r_ok = inb & (r_idx < n_dim)
+    r_idx = jnp.clip(r_idx, 0, plen_d - 1)
+    hit = r_ok & (jnp.take(dkey.astype(jnp.int64), r_idx) == fk)
+    hit = hit & jnp.take(ok_d, r_idx)
+    ok_f = jnp.arange(plen_f) < n_fact
+    if fvalid is not None:
+        ok_f = ok_f & fvalid
+    if f_excl is not None:
+        ok_f = ok_f & ~f_excl
+    return r_idx, hit & ok_f
+
+
 def pk_gather_join(fact_key: Column, dim_key: Column,
                    n_fact: int, n_dim: int, f_excl=None, d_excl=None):
     """Planner-facing wrapper of :func:`_pk_gather_impl`: prepares
-    comparable integer views (merged dictionary ranks for string pairs)."""
+    comparable integer views (merged dictionary ranks for string pairs),
+    and takes the dense-range position-map probe when the dimension key
+    is a dense unique integer range (all TPC-DS surrogate keys)."""
     if fact_key.kind == "str" and dim_key.kind == "str":
         fview, dview = ordered_codes_merged(fact_key, dim_key)
     else:
         fview, dview = fact_key.data, dim_key.data
+        dense = _dense_dim_info(dim_key, n_dim)
+        if dense is not None:
+            base, pos_map = dense
+            return _pk_gather_dense_impl(
+                fview, fact_key.valid, dview, dim_key.valid, pos_map,
+                jnp.int64(base), n_fact, n_dim, f_excl, d_excl)
     return _pk_gather_impl(fview, fact_key.valid, dview, dim_key.valid,
                            n_fact, n_dim, f_excl, d_excl)
 

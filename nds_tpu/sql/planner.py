@@ -109,32 +109,90 @@ class Planner:
 
     def _collect_needed_names(self, node) -> set | None:
         """Bare (unqualified, lowercased) column names referenced anywhere in
-        the statement, or None when a SELECT * makes pruning unsafe. Over-
-        approximates across subqueries — pruning only ever drops columns NO
-        expression in the whole statement mentions, and a miss fails loudly
-        at name resolution, never silently."""
+        the statement, or None when pruning is unsafe. Over-approximates
+        across subqueries — pruning only ever drops columns NO expression in
+        the whole statement mentions, and a miss fails loudly at name
+        resolution, never silently.
+
+        SELECT * is resolved SCOPED instead of disabling pruning globally
+        (q21-class queries wrap a narrow aggregate in ``select * from (...)``
+        — without scoping, every base scan under the subquery drags all of
+        its columns through the join). A star over a derived table needs
+        nothing (the inner projection is explicit and its refs are walked);
+        a star over a catalog table adds that table's full column set; only
+        a star over an unresolvable name disables pruning."""
         names: set = set()
         star = False
+        # names that resolve to derived tables (CTEs) anywhere in the
+        # statement; a name that is ALSO a catalog table stays conservative
+        cte_names: set = set()
 
-        def walk(x):
+        def collect_ctes(x):
+            if isinstance(x, A.Query):
+                for cname, _ in x.ctes:
+                    cte_names.add(cname.lower())
+            if hasattr(x, "__dataclass_fields__"):
+                for f in vars(x).values():
+                    collect_any(f, collect_ctes)
+
+        def collect_any(f, fn):
+            if isinstance(f, (list, tuple)):
+                for y in f:
+                    collect_any(y, fn)
+            elif hasattr(f, "__dataclass_fields__"):
+                fn(f)
+        collect_ctes(node)
+
+        def from_leaves(f, out):
+            if f is None:
+                return
+            if isinstance(f, A.TableRef):
+                out.append(f)
+            elif isinstance(f, A.Join):
+                from_leaves(f.left, out)
+                from_leaves(f.right, out)
+            # SubqueryRef leaves contribute nothing: their projections are
+            # explicit and walked on their own
+
+        def resolve_star(sel: A.Select, qualifier):
+            """Add the base columns a star could expand to; returns False
+            when any leaf is unresolvable (disable pruning)."""
+            leaves: list = []
+            from_leaves(sel.from_, leaves)
+            for leaf in leaves:
+                alias = (leaf.alias or leaf.name).lower()
+                if qualifier and qualifier.lower() != alias:
+                    continue
+                name_l = leaf.name.lower()
+                t = self.catalog.get(name_l) or self.catalog.get(leaf.name)
+                if t is not None:
+                    names.update(n.split(".")[-1].lower()
+                                 for n in t.column_names)
+                elif name_l not in cte_names:
+                    return False              # unknown leaf: stay safe
+            return True
+
+        def walk(x, sel=None):
             nonlocal star
             if star or x is None:
                 return
             if isinstance(x, A.Star):
-                star = True
+                if sel is None or not resolve_star(sel, x.table):
+                    star = True
                 return
             if isinstance(x, A.ColumnRef):
                 names.add(x.name.lower())
+            here = x if isinstance(x, A.Select) else sel
             if hasattr(x, "__dataclass_fields__"):
                 for f in vars(x).values():
-                    walk_any(f)
+                    walk_any(f, here)
 
-        def walk_any(f):
+        def walk_any(f, sel):
             if isinstance(f, (list, tuple)):
                 for y in f:
-                    walk_any(y)
+                    walk_any(y, sel)
             elif hasattr(f, "__dataclass_fields__"):
-                walk(f)
+                walk(f, sel)
         walk(node)
         return None if star else names
 
