@@ -566,7 +566,9 @@ def _key_hash_impl(views, valids, side_salt: int, null_safe: bool, n_valid,
     if excluded is not None:
         unmatchable = unmatchable | excluded
     row_ids = jnp.arange(n, dtype=jnp.uint64)
-    sentinel = jnp.uint64(1 if side_salt else 2) + (row_ids << jnp.uint64(2))
+    # bit layout: bits 0-1 side tag, bit 2 = REAL marker (exactly zero on
+    # sentinels — the exchange path classifies on it), row id from bit 3
+    sentinel = jnp.uint64(1 if side_salt else 2) + (row_ids << jnp.uint64(3))
     return jnp.where(unmatchable, sentinel, h | jnp.uint64(4))
 
 
