@@ -1,0 +1,225 @@
+// Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+//
+// ndsrun: native distributed data-generation runner.
+//
+// The role of the reference's Hadoop MapReduce wrapper (ref:
+// nds/tpcds-gen/src/main/java/org/notmysock/tpcds/GenTable.java:50-167):
+// split the dsdgen child-chunk range across pod hosts, launch one worker
+// command per host, supervise exits, and re-run a failed host's span on a
+// surviving host (the MR framework's task-retry role, GenTable relies on
+// mapreduce.map.maxattempts). Workers exec the framework's own driver in
+// `local` mode on each host, landing per-table flat files on the shared
+// data directory exactly like the mapper's MultipleOutputs layout.
+//
+// Launchers:
+//   ssh   (default)  ssh <host> <python> <driver> local ...
+//   local            run the worker command on this machine (testing; the
+//                    scheduling/retry logic is identical)
+//
+// Usage:
+//   ndsrun -hosts h1,h2,h3 -scale 100 -parallel 96 -dir /shared/raw
+//          [-range a,b] [-update N] [-rngseed S] [-overwrite]
+//          [-driver /repo/nds_gen_data.py] [-python python3]
+//          [-launcher ssh|local] [-retries 2]
+
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Span {
+  int lo = 1, hi = 1;
+};
+
+struct Options {
+  std::vector<std::string> hosts;
+  std::string scale, dir, update, rngseed;
+  std::string driver = "nds_gen_data.py";
+  std::string python = "python3";
+  std::string launcher = "ssh";
+  int parallel = 0;
+  int range_lo = 0, range_hi = 0;  // 0 = full 1..parallel
+  bool overwrite = false;
+  int retries = 2;
+};
+
+std::vector<std::string> split(const std::string& s, char sep) {
+  std::vector<std::string> out;
+  size_t start = 0;
+  while (start <= s.size()) {
+    size_t end = s.find(sep, start);
+    if (end == std::string::npos) end = s.size();
+    if (end > start) out.push_back(s.substr(start, end - start));
+    start = end + 1;
+  }
+  return out;
+}
+
+// contiguous chunk spans, one per host (mirrors the Python driver's
+// _split_ranges so both schedulers land identical per-host work)
+std::vector<Span> split_spans(int lo, int hi, int n) {
+  std::vector<Span> spans;
+  int total = hi - lo + 1, start = lo;
+  for (int i = 0; i < n; i++) {
+    int size = total / n + (i < total % n ? 1 : 0);
+    if (size == 0) continue;
+    spans.push_back({start, start + size - 1});
+    start += size;
+  }
+  return spans;
+}
+
+std::vector<std::string> worker_cmd(const Options& opt,
+                                    const std::string& host, Span span) {
+  std::vector<std::string> cmd;
+  if (opt.launcher == "ssh") {
+    cmd = {"ssh", host};
+  }
+  cmd.insert(cmd.end(), {opt.python, opt.driver, "local", opt.scale,
+                         std::to_string(opt.parallel), opt.dir, "--range",
+                         std::to_string(span.lo) + "," +
+                             std::to_string(span.hi)});
+  if (!opt.update.empty()) cmd.insert(cmd.end(), {"--update", opt.update});
+  if (!opt.rngseed.empty()) cmd.insert(cmd.end(), {"--rngseed", opt.rngseed});
+  if (opt.overwrite) cmd.push_back("--overwrite_output");
+  return cmd;
+}
+
+pid_t spawn(const std::vector<std::string>& cmd) {
+  pid_t pid = fork();
+  if (pid != 0) return pid;
+  std::vector<char*> argv;
+  argv.reserve(cmd.size() + 1);
+  for (const auto& a : cmd) argv.push_back(const_cast<char*>(a.c_str()));
+  argv.push_back(nullptr);
+  execvp(argv[0], argv.data());
+  perror("execvp");
+  _exit(127);
+}
+
+struct Task {
+  pid_t pid;
+  std::string host;
+  Span span;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Options opt;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) {
+        std::fprintf(stderr, "missing value for %s\n", a.c_str());
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "-hosts") {
+      opt.hosts = split(next(), ',');
+    } else if (a == "-scale") {
+      opt.scale = next();
+    } else if (a == "-parallel") {
+      opt.parallel = std::atoi(next().c_str());
+    } else if (a == "-dir") {
+      opt.dir = next();
+    } else if (a == "-range") {
+      auto parts = split(next(), ',');
+      if (parts.size() != 2) {
+        std::fprintf(stderr, "-range expects a,b\n");
+        return 2;
+      }
+      opt.range_lo = std::atoi(parts[0].c_str());
+      opt.range_hi = std::atoi(parts[1].c_str());
+    } else if (a == "-update") {
+      opt.update = next();
+    } else if (a == "-rngseed") {
+      opt.rngseed = next();
+    } else if (a == "-overwrite") {
+      opt.overwrite = true;
+    } else if (a == "-driver") {
+      opt.driver = next();
+    } else if (a == "-python") {
+      opt.python = next();
+    } else if (a == "-launcher") {
+      opt.launcher = next();
+    } else if (a == "-retries") {
+      opt.retries = std::atoi(next().c_str());
+    } else {
+      std::fprintf(stderr, "unknown flag %s\n", a.c_str());
+      return 2;
+    }
+  }
+  if (opt.hosts.empty() || opt.scale.empty() || opt.dir.empty() ||
+      opt.parallel <= 0) {
+    std::fprintf(stderr,
+                 "usage: ndsrun -hosts h1,h2 -scale S -parallel N -dir D "
+                 "[-range a,b] [-update N] [-rngseed S] [-overwrite] "
+                 "[-driver path] [-python exe] [-launcher ssh|local] "
+                 "[-retries K]\n");
+    return 2;
+  }
+  int lo = opt.range_lo ? opt.range_lo : 1;
+  int hi = opt.range_hi ? opt.range_hi : opt.parallel;
+
+  std::vector<Task> running;
+  std::vector<std::string> ok_hosts;
+  std::vector<Span> failed;
+
+  auto launch = [&](const std::string& host, Span span) {
+    auto cmd = worker_cmd(opt, host, span);
+    std::string line;
+    for (const auto& c : cmd) line += c + " ";
+    std::fprintf(stderr, "[ndsrun] %s\n", line.c_str());
+    running.push_back({spawn(cmd), host, span});
+  };
+
+  auto spans = split_spans(lo, hi, static_cast<int>(opt.hosts.size()));
+  for (size_t i = 0; i < spans.size(); i++) launch(opt.hosts[i], spans[i]);
+
+  auto drain = [&]() {
+    for (auto& t : running) {
+      int status = 0;
+      waitpid(t.pid, &status, 0);
+      bool good = WIFEXITED(status) && WEXITSTATUS(status) == 0;
+      if (good) {
+        if (std::find(ok_hosts.begin(), ok_hosts.end(), t.host) ==
+            ok_hosts.end())
+          ok_hosts.push_back(t.host);
+      } else {
+        std::fprintf(stderr, "[ndsrun] host %s failed for range %d,%d\n",
+                     t.host.c_str(), t.span.lo, t.span.hi);
+        failed.push_back(t.span);
+      }
+    }
+    running.clear();
+  };
+  drain();
+
+  for (int attempt = 0; attempt < opt.retries && !failed.empty(); attempt++) {
+    if (ok_hosts.empty()) break;
+    auto todo = failed;
+    failed.clear();
+    for (size_t i = 0; i < todo.size(); i++)
+      launch(ok_hosts[i % ok_hosts.size()], todo[i]);
+    drain();
+  }
+
+  if (!failed.empty()) {
+    std::fprintf(stderr, "[ndsrun] %zu range(s) still failing\n",
+                 failed.size());
+    return 1;
+  }
+  std::fprintf(stderr, "[ndsrun] complete: chunks %d-%d across %zu host(s)\n",
+               lo, hi, opt.hosts.size());
+  return 0;
+}

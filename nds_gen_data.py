@@ -139,6 +139,27 @@ def generate_data_dist(args, tool_path, range_start, range_end):
         return generate_data_local(args, tool_path, range_start, range_end)
     data_dir = _prepare_out_dir(args)
 
+    # native runner (C++ host fan-out with retry, the MR wrapper's role;
+    # native/ndsrun) when built; the Python fan-out below is the fallback
+    ndsrun = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "native", "ndsrun", "ndsrun")
+    if os.path.exists(ndsrun) and not os.environ.get("NDS_NO_NDSRUN"):
+        cmd = [ndsrun, "-hosts", ",".join(host_list), "-scale", args.scale,
+               "-parallel", str(args.parallel), "-dir", data_dir,
+               "-range", f"{range_start},{range_end}",
+               "-driver", os.path.abspath(__file__),
+               "-python", sys.executable]
+        if args.update:
+            cmd += ["-update", args.update]
+        if args.rngseed:
+            cmd += ["-rngseed", args.rngseed]
+        if args.overwrite_output:
+            cmd += ["-overwrite"]
+        subprocess.run(cmd, check=True)
+        print(f"distributed generation complete across {len(host_list)} "
+              f"hosts -> {data_dir}")
+        return
+
     def spawn(host, lo, hi):
         sub = [sys.executable, os.path.abspath(__file__), "local",
                args.scale, str(args.parallel), get_abs_path(args.data_dir),

@@ -1,0 +1,84 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Native distributed gen runner (native/ndsrun): chunk-span scheduling and
+failed-span retry on surviving hosts, exercised with -launcher local and a
+scripted flaky worker (the MR wrapper's task-retry role, ref:
+nds/tpcds-gen/.../GenTable.java)."""
+
+import os
+import stat
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NDSRUN = os.path.join(REPO, "native", "ndsrun", "ndsrun")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build():
+    subprocess.run(["make", "-C", os.path.dirname(NDSRUN)], check=True,
+                   capture_output=True)
+
+
+def _write_driver(path, body):
+    path.write_text("#!/usr/bin/env python3\n" + body)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+
+
+def test_spans_cover_range_and_land_args(tmp_path):
+    log = tmp_path / "log"
+    log.mkdir()
+    drv = tmp_path / "driver.py"
+    _write_driver(drv, f"""
+import sys, os
+args = sys.argv[1:]
+rng = args[args.index("--range") + 1]
+open(os.path.join({str(log)!r}, rng.replace(",", "_")), "w").write(" ".join(args))
+""")
+    subprocess.run(
+        [NDSRUN, "-hosts", "h1,h2,h3", "-scale", "1", "-parallel", "8",
+         "-dir", str(tmp_path / "out"), "-launcher", "local",
+         "-python", "python3", "-driver", str(drv), "-rngseed", "7"],
+        check=True, capture_output=True)
+    spans = sorted(f.name for f in log.iterdir())
+    assert spans == ["1_3", "4_6", "7_8"]
+    body = (log / "1_3").read_text()
+    assert "local 1 8" in body and "--rngseed 7" in body
+
+
+def test_failed_span_retries_on_surviving_host(tmp_path):
+    log = tmp_path / "log"
+    log.mkdir()
+    drv = tmp_path / "driver.py"
+    # the worker owning chunks 4,6 fails on its FIRST attempt only
+    _write_driver(drv, f"""
+import sys, os
+args = sys.argv[1:]
+rng = args[args.index("--range") + 1]
+marker = os.path.join({str(log)!r}, "failed_once")
+if rng == "4,6" and not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(3)
+open(os.path.join({str(log)!r}, "ok_" + rng.replace(",", "_")), "w").close()
+""")
+    r = subprocess.run(
+        [NDSRUN, "-hosts", "a,b,c", "-scale", "1", "-parallel", "8",
+         "-dir", str(tmp_path / "out"), "-launcher", "local",
+         "-python", "python3", "-driver", str(drv)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    names = {f.name for f in log.iterdir()}
+    assert {"ok_1_3", "ok_4_6", "ok_7_8", "failed_once"} <= names
+    assert "failed for range 4,6" in r.stderr
+
+
+def test_permanently_failing_span_exits_nonzero(tmp_path):
+    drv = tmp_path / "driver.py"
+    _write_driver(drv, "import sys; sys.exit(1)\n")
+    r = subprocess.run(
+        [NDSRUN, "-hosts", "a,b", "-scale", "1", "-parallel", "4",
+         "-dir", str(tmp_path / "out"), "-launcher", "local",
+         "-python", "python3", "-driver", str(drv), "-retries", "2"],
+        capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "still failing" in r.stderr
