@@ -55,6 +55,20 @@ def bucket_len(n: int) -> int:
     return 1 << (int(n) - 1).bit_length()
 
 
+# host-sync accounting: every device->host scalar read blocks the dispatch
+# queue (and under GSPMD is a full-mesh barrier through the host), so the
+# count per query is THE scalability number to watch (DESIGN.md). Reset /
+# read around a query by the drivers.
+sync_count = 0
+
+
+def host_sync(value) -> int:
+    """Read a device scalar on host, counting the sync."""
+    global sync_count
+    sync_count += 1
+    return int(value)
+
+
 def live_mask(plen: int, nrows: int) -> jnp.ndarray:
     """Bool mask of the logical (non-pad) prefix of a physical array."""
     return jnp.arange(plen) < nrows
@@ -73,7 +87,7 @@ def compact_table(table: DeviceTable, mask: jnp.ndarray) -> DeviceTable:
     """Keep rows where ``mask`` is true, re-bucketing to a prefix-padded
     table. The single host sync is the row count."""
     m = mask & live_mask(table.plen, table.nrows)
-    n = int(jnp.sum(m))
+    n = host_sync(jnp.sum(m))
     return take_padded(table, compact_indices(m, n), n)
 
 
@@ -325,7 +339,7 @@ def group_ids(key_cols, n_valid: int | None = None):
     views = tuple(sortable_view(c) for c in key_cols)
     valids = tuple(c.valid for c in key_cols)
     gids, ng_dev = _group_ids_impl(views, valids, n_valid)
-    ngroups = int(ng_dev)                            # the one host sync
+    ngroups = host_sync(ng_dev)                      # the one host sync
     cap = bucket_len(ngroups)
     rep = _group_rep_impl(gids, n_valid, cap)
     return gids, ngroups, rep, cap
@@ -659,7 +673,7 @@ def _probe_candidates(left_keys, right_keys, null_safe=False,
     lo = jnp.searchsorted(rh_sorted, lh, side="left")
     hi = jnp.searchsorted(rh_sorted, lh, side="right")
     counts = hi - lo
-    total = int(jnp.sum(counts))                       # host sync 1
+    total = host_sync(jnp.sum(counts))                 # host sync 1
     return counts, lo, order, total
 
 
@@ -693,7 +707,7 @@ def join_indices(left_keys, right_keys, how: str = "inner",
         pair_live = live_mask(cand, total)
         ok = _verify_pairs(l_idx, r_idx, left_keys, right_keys, null_safe)
         ok = ok & pair_live
-        n_pairs = int(jnp.sum(ok))                     # host sync 2
+        n_pairs = host_sync(jnp.sum(ok))               # host sync 2
         keep = jnp.nonzero(ok, size=bucket_len(n_pairs), fill_value=cand)[0]
         # out-of-range pads: point pad pairs past both inputs
         l_idx = jnp.take(l_idx, keep, mode="fill", fill_value=plen_l)
@@ -712,7 +726,7 @@ def join_indices(left_keys, right_keys, how: str = "inner",
         miss = ~matched & live_mask(plen_l, n_left)
         if l_excl is not None:
             miss = miss & ~l_excl
-        n_lx = int(jnp.sum(miss))
+        n_lx = host_sync(jnp.sum(miss))
         l_extra = compact_indices(miss, n_lx)
     if how in ("right", "full"):
         matched_r = jnp.zeros(plen_r, dtype=bool).at[r_idx].set(
@@ -720,7 +734,7 @@ def join_indices(left_keys, right_keys, how: str = "inner",
         miss_r = ~matched_r & live_mask(plen_r, n_right)
         if r_excl is not None:
             miss_r = miss_r & ~r_excl
-        n_rx = int(jnp.sum(miss_r))
+        n_rx = host_sync(jnp.sum(miss_r))
         r_extra = compact_indices(miss_r, n_rx)
     return l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx
 
@@ -935,7 +949,7 @@ def _chunked_inner_join(left, right, left_keys, right_keys, probe,
         schema_chunk = raw
         if residual_fn is not None:
             ok = ok & residual_fn(raw)
-        n_live = int(jnp.sum(ok))                      # host sync per span
+        n_live = host_sync(jnp.sum(ok))                # host sync per span
         if n_live == 0:
             continue
         keep = compact_indices(ok, n_live)
@@ -965,7 +979,7 @@ def _exchange_inner_join(left, right, left_keys, right_keys, mesh,
         lh, jnp.arange(plen_l, dtype=jnp.int64),
         rh, jnp.arange(plen_r, dtype=jnp.int64), mesh)
     ok = live & _verify_pairs(l_idx_x, r_idx_x, left_keys, right_keys)
-    n_pairs = int(jnp.sum(ok))                         # host sync
+    n_pairs = host_sync(jnp.sum(ok))                   # host sync
     keep = jnp.nonzero(ok, size=bucket_len(n_pairs),
                        fill_value=int(ok.shape[0]))[0]
     l_idx = jnp.take(l_idx_x, keep, mode="fill", fill_value=plen_l)

@@ -181,6 +181,8 @@ def run_query_stream(input_prefix: str,
             import jax.profiler as _prof
             trace_ctx = _prof.trace(os.path.join(profile_folder, query_name))
             trace_ctx.__enter__()
+        from nds_tpu.engine import ops as _ops
+        syncs_before = _ops.sync_count
         try:
             elapsed = q_report.report_on(run_one_query, session, q_content,
                                          query_name, output_path,
@@ -188,6 +190,10 @@ def run_query_stream(input_prefix: str,
         finally:
             if trace_ctx is not None:
                 trace_ctx.__exit__(None, None, None)
+        # per-query host-sync count: each is a dispatch-queue flush (and a
+        # full-mesh barrier under GSPMD) — the scalability number DESIGN.md
+        # tracks
+        q_report.summary["hostSyncs"] = _ops.sync_count - syncs_before
         print(f"Time taken: [{elapsed}] millis for {query_name}")
         execution_time_list.append((session.app_id, query_name, elapsed))
         q_report.summary["query"] = query_name
