@@ -1,0 +1,46 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Throughput Run: N concurrent Power Runs, one per stream, through the
+nds-throughput launcher (ref: nds/nds-throughput:19-23) — the
+concurrent-stream parallelism axis (SURVEY.md §2.4.4). Exercised at tiny
+scale on the CPU platform with two streams; the time logs and per-stream
+JSON summaries must land independently."""
+
+import csv
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+
+def test_two_concurrent_streams(tmp_path):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", NDS_TPU_COMP_CACHE="force",
+               PYTHONPATH=REPO)
+    data = os.path.join(REPO, ".bench_cache", "sf0.01")
+    if not os.path.exists(os.path.join(data, ".complete")):
+        pytest.skip("SF0.01 cache not generated")
+    streams = tmp_path / "streams"
+    subprocess.run(
+        ["python3", os.path.join(REPO, "nds_gen_query_stream.py"),
+         "--streams", "2", "--rngseed", "31", "0.01", str(streams)],
+        check=True, env=env, cwd=REPO)
+    for s in (0, 1):
+        assert (streams / f"query_{s}.sql").exists()
+    # trim each stream to two cheap queries for the concurrency smoke
+    r = subprocess.run(
+        [os.path.join(REPO, "nds-throughput"), "0,1",
+         "python3", os.path.join(REPO, "nds_power.py"), data,
+         str(streams / "query_{}.sql"), str(tmp_path / "time_{}.csv"),
+         "--input_format", "csv", "--sub_queries", "query3,query52",
+         "--json_summary_folder", str(tmp_path / "json_{}")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=1200)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    for s in (0, 1):
+        rows = list(csv.reader(open(tmp_path / f"time_{s}.csv")))
+        names = [row[1] for row in rows]
+        assert "query3" in names and "query52" in names
+        js = list((tmp_path / f"json_{s}").glob("*.json"))
+        assert len(js) == 2
