@@ -26,6 +26,42 @@ TABLE_PARTITIONING = {
 }
 
 
+def _hive_partition_runs(table: pa.Table, partition_col: str):
+    """Yield (partition dir name, partition slice) by sorting on the
+    partition column and slicing contiguous runs — ONE pass, one file per
+    partition. pyarrow's dataset writer churns past its open-file cap when
+    a fact table has a 5-year daily date_sk domain (observed: 54M tiny
+    write syscalls on store_sales SF1), so both formats partition through
+    this path (Spark's partitionBy sort-within semantics; ref:
+    nds/nds_transcode.py:69-152 date-partitioned fact tables)."""
+    import numpy as np
+    order = pa.compute.sort_indices(
+        table, sort_keys=[(partition_col, "ascending")])
+    sorted_tbl = table.take(order)
+    col = sorted_tbl[partition_col].to_numpy(zero_copy_only=False)
+    # nulls sort to the end and surface as NaN; NaN != NaN would split
+    # them into 1-row runs, so bound the non-null region first
+    n_null = int(pa.compute.is_null(sorted_tbl[partition_col]).to_numpy(
+        zero_copy_only=False).sum())
+    n_valid = len(col) - n_null
+    valid = col[:n_valid]
+    boundaries = [0] + list(np.nonzero(valid[1:] != valid[:-1])[0] + 1) + \
+        [n_valid]
+    if n_null:
+        boundaries.append(len(col))
+    for lo, hi in zip(boundaries[:-1], boundaries[1:]):
+        value = col[lo]
+        if value is None or value != value:          # null (None or NaN)
+            part_name = "__HIVE_DEFAULT_PARTITION__"
+        else:
+            # nullable int columns surface as floats in numpy; keep
+            # integral partition names so hive read-back types match
+            part_name = str(int(value)) if float(value).is_integer() \
+                else str(value)
+        yield (f"{partition_col}={part_name}",
+               sorted_tbl.slice(lo, hi - lo).drop_columns([partition_col]))
+
+
 def write_table(table: pa.Table, path: str, fmt: str = "parquet",
                 partition_col: str | None = None, compression: str | None = None) -> None:
     os.makedirs(path, exist_ok=True)
@@ -33,43 +69,20 @@ def write_table(table: pa.Table, path: str, fmt: str = "parquet",
         import pyarrow.parquet as pq
         comp = compression or "snappy"
         if partition_col:
-            # a 5-year daily date_sk window exceeds pyarrow's default
-            # 1024-partition cap
-            pq.write_to_dataset(table, root_path=path, partition_cols=[partition_col],
-                                compression=comp, max_partitions=1 << 16)
+            for part_dir, part in _hive_partition_runs(table, partition_col):
+                sub = os.path.join(path, part_dir)
+                os.makedirs(sub, exist_ok=True)
+                pq.write_table(part, os.path.join(sub, "part-0.parquet"),
+                               compression=comp)
         else:
             pq.write_table(table, os.path.join(path, "part-0.parquet"), compression=comp)
     elif fmt == "orc":
         import pyarrow.orc as paorc
         comp = compression or "zstd"
         if partition_col:
-            # pyarrow.dataset cannot write ORC; hive-partition in one pass by
-            # sorting on the partition column and slicing contiguous runs
-            order = pa.compute.sort_indices(
-                table, sort_keys=[(partition_col, "ascending")])
-            sorted_tbl = table.take(order)
-            col = sorted_tbl[partition_col].to_numpy(zero_copy_only=False)
-            import numpy as np
-            # nulls sort to the end and surface as NaN; NaN != NaN would split
-            # them into 1-row runs, so bound the non-null region first
-            n_null = int(pa.compute.is_null(sorted_tbl[partition_col]).to_numpy(
-                zero_copy_only=False).sum())
-            n_valid = len(col) - n_null
-            valid = col[:n_valid]
-            boundaries = [0] + list(np.nonzero(valid[1:] != valid[:-1])[0] + 1) + [n_valid]
-            if n_null:
-                boundaries.append(len(col))
-            for lo, hi in zip(boundaries[:-1], boundaries[1:]):
-                value = col[lo]
-                if value is None or value != value:  # null (None or NaN)
-                    part_name = "__HIVE_DEFAULT_PARTITION__"
-                else:
-                    # nullable int columns surface as floats in numpy; keep
-                    # integral partition names so hive read-back types match
-                    part_name = str(int(value)) if float(value).is_integer() else str(value)
-                sub = os.path.join(path, f"{partition_col}={part_name}")
+            for part_dir, part in _hive_partition_runs(table, partition_col):
+                sub = os.path.join(path, part_dir)
                 os.makedirs(sub, exist_ok=True)
-                part = sorted_tbl.slice(lo, hi - lo).drop_columns([partition_col])
                 paorc.write_table(part, os.path.join(sub, "part-0.orc"),
                                   compression=comp)
         else:
