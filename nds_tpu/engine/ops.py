@@ -959,6 +959,75 @@ def pk_gather_join(fact_key: Column, dim_key: Column,
                            n_fact, n_dim, f_excl, d_excl)
 
 
+_dim_span_cache: dict = {}
+
+
+@jax.jit
+def _pack_keys_impl(views, valids, offsets, widths, spans):
+    """Pack offset key codes into one int64, with a combined validity
+    (per-key nulls AND in-range — a fact key outside the dim's span can
+    never match)."""
+    plen = views[0].shape[0]
+    packed = jnp.zeros(plen, dtype=jnp.int64)
+    ok = jnp.ones(plen, dtype=bool)
+    for v, valid, off, width, span in zip(views, valids, offsets, widths,
+                                          spans):
+        k = v.astype(jnp.int64) - off
+        ok = ok & (k >= 0) & (k <= span)
+        if valid is not None:
+            ok = ok & valid
+        packed = (packed << width) | jnp.clip(k, 0, span)
+    return packed, ok
+
+
+def pk_gather_join_multi(fact_keys, dim_keys, n_fact: int, n_dim: int,
+                         f_excl=None, d_excl=None):
+    """Composite-key merge probe against a UNIQUE key set (the fact/returns
+    composite primary keys): pack every key into one int64 (widths from the
+    dim side's value spans — one fused range sync, identity-cached per key
+    set) and run the single-key exact probe. Returns ``(r_idx, matched)``
+    or None when the keys cannot pack (non-integer kinds or >62 combined
+    bits) — callers fall back to the hash join."""
+    if len(fact_keys) == 1:
+        return pk_gather_join(fact_keys[0], dim_keys[0], n_fact, n_dim,
+                              f_excl, d_excl)
+    kinds = {c.kind for c in list(fact_keys) + list(dim_keys)}
+    if any(k in ("str", "f64") or k.startswith("dec") for k in kinds):
+        return None
+
+    def compute():
+        global sync_count
+        mins, maxs = _int_key_ranges(
+            tuple(c.data for c in dim_keys), n_dim)
+        sync_count += 1
+        mins, maxs = np.asarray(mins), np.asarray(maxs)
+        offsets, widths, spans, total = [], [], [], 0
+        for lo, hi in zip(mins, maxs):
+            span = max(int(hi) - int(lo), 0)
+            width = max(int(span).bit_length(), 1)
+            offsets.append(int(lo))
+            widths.append(width)
+            spans.append(span)
+            total += width
+        if total > 62:
+            return None
+        return tuple(offsets), tuple(widths), tuple(spans)
+
+    plan = _identity_cache(_dim_span_cache, 128,
+                           tuple(c.data for c in dim_keys), compute)
+    if plan is None:
+        return None
+    offsets, widths, spans = plan
+    fpacked, fok = _pack_keys_impl(
+        tuple(c.data for c in fact_keys),
+        tuple(c.valid for c in fact_keys), offsets, widths, spans)
+    dpacked, dok = _pack_keys_impl(
+        tuple(c.data for c in dim_keys),
+        tuple(c.valid for c in dim_keys), offsets, widths, spans)
+    return _pk_gather_impl(fpacked, fok, dpacked, dok, n_fact, n_dim,
+                           f_excl, d_excl)
+
+
 def _null_column_like(col: Column, n: int) -> Column:
     data = jnp.zeros((n,) + col.data.shape[1:], dtype=col.data.dtype)
     return Column(col.kind, data, jnp.zeros(n, dtype=bool), col.dict_values)

@@ -390,7 +390,12 @@ class Planner:
             rw = self._consume_pushable(where, rp) \
                 if from_.kind == "right" else []
             right = self._join_parts(rp, rj, rw, rs)
-            joined = self._binary_join(left, right, from_.kind, from_.condition)
+            # single-leaf scan provenance survives filtering (uniqueness is
+            # key-set property, not row-set) — _binary_join uses it to turn
+            # LEFT joins on a declared (composite) PK into gathers
+            right_src = rs[0] if len(rs) == 1 else None
+            joined = self._binary_join(left, right, from_.kind,
+                                       from_.condition, right_src=right_src)
             return [joined], [], [None]
         raise ExecError(f"unsupported FROM clause {type(from_).__name__}")
 
@@ -519,7 +524,7 @@ class Planner:
         return None
 
     def _binary_join(self, left: DeviceTable, right: DeviceTable, kind: str,
-                     condition) -> DeviceTable:
+                     condition, right_src: str | None = None) -> DeviceTable:
         conjuncts = [h for c in self._split_conjuncts(condition)
                      for h in self._hoist_or_conjuncts(c)]
         lcols, rcols = set(left.column_names), set(right.column_names)
@@ -575,6 +580,31 @@ class Planner:
         if not residual and all_plain:
             l_on = [l for l, _ in equi]
             r_on = [r for _, r in equi]
+            if kind == "left" and right_src:
+                # LEFT join on the right side's declared (composite) PK:
+                # at most one match per probe row, so gather right columns
+                # onto the left's unchanged physical rows and null-extend
+                # misses — no pair machinery, no syncs (q78-class
+                # sales x returns joins). Uniqueness is a schema fact.
+                from nds_tpu.schema import (COMPOSITE_PRIMARY_KEYS,
+                                            PRIMARY_KEYS)
+                pk = COMPOSITE_PRIMARY_KEYS.get(right_src)
+                if pk is None and right_src in PRIMARY_KEYS:
+                    pk = (PRIMARY_KEYS[right_src],)
+                bare = {r.split(".")[-1] for r in r_on}
+                if pk is not None and bare == set(pk):
+                    got = E.pk_gather_join_multi(
+                        [left[n] for n in l_on], [right[n] for n in r_on],
+                        left.nrows, right.nrows)
+                    if got is not None:
+                        r_idx, matched = got
+                        cols = dict(left.columns)
+                        rg = E.gather_table_rows(right, r_idx, left.nrows)
+                        for n, c in rg.columns.items():
+                            cols[n] = Column(c.kind, c.data,
+                                             c.valid_mask() & matched,
+                                             c.dict_values)
+                        return DeviceTable(cols, left.nrows, plen=left.plen)
             return E.join_tables(left, right, l_on, r_on, kind)
         # join with residual and/or expression keys: match pairs on the key
         # columns, filter by the residual conjuncts, then rebuild outer rows
@@ -618,29 +648,43 @@ class Planner:
     def _pk_gather_plan(self, tables, sources, a, b, es):
         """Eligibility of the (a, b) edge batch for a PK gather join.
 
-        Requires a single equi edge whose dimension side is still a pristine
-        base-table scan (``sources`` survives deferred filters and earlier
-        gather joins, which never change a slot's physical rows) joining on
-        its declared single-column primary key — uniqueness is a schema
-        fact, so no runtime check or sync is needed. Returns
-        ``(fact_slot, dim_slot, fact_key, dim_key)`` or None."""
-        from nds_tpu.schema import PRIMARY_KEYS
-        if len(es) != 1 or os.environ.get("NDS_TPU_NO_PK_GATHER"):
+        Requires the edge batch's dimension-side key set to be exactly the
+        declared primary key — single-column (any surrogate kind) or
+        composite (integer kinds; packed into one probe key) — of a still-
+        pristine base-table scan (``sources`` survives deferred filters and
+        earlier gather joins, which never change a slot's physical rows).
+        Uniqueness is a schema fact, so no runtime check or sync is needed.
+        Returns ``(fact_slot, dim_slot, [fact_keys], [dim_keys])`` or
+        None."""
+        from nds_tpu.schema import COMPOSITE_PRIMARY_KEYS, PRIMARY_KEYS
+        if os.environ.get("NDS_TPU_NO_PK_GATHER"):
             return None
-        (sl, sr, lk, rk) = es[0]
-        ak, bk = (lk, rk) if sl == a else (rk, lk)
-        for fact_slot, dim_slot, fk, dk in ((a, b, ak, bk), (b, a, bk, ak)):
+        pairs = [((lk, rk) if sl == a else (rk, lk)) for (sl, sr, lk, rk)
+                 in es]
+        for fact_slot, dim_slot, idx in ((a, b, 1), (b, a, 0)):
             src = sources[dim_slot]
-            pk = PRIMARY_KEYS.get(src) if src else None
-            if pk is None or dk.split(".")[-1] != pk:
+            if not src:
                 continue
-            fkc = tables[fact_slot][fk]
-            dkc = tables[dim_slot][dk]
-            if fkc.kind == "f64" or dkc.kind == "f64":
-                continue                      # int/date/str surrogate keys only
-            if (fkc.kind == "str") != (dkc.kind == "str"):
+            dks = [p[idx] for p in pairs]
+            fks = [p[1 - idx] for p in pairs]
+            bare = {d.split(".")[-1] for d in dks}
+            if len(es) == 1 and bare == {PRIMARY_KEYS.get(src)}:
+                pass                           # single-column PK
+            elif bare == set(COMPOSITE_PRIMARY_KEYS.get(src, ())):
+                pass                           # composite PK (full cover)
+            else:
                 continue
-            return fact_slot, dim_slot, fk, dk
+            ok = True
+            for fk, dk in zip(fks, dks):
+                fkc, dkc = tables[fact_slot][fk], tables[dim_slot][dk]
+                if fkc.kind == "f64" or dkc.kind == "f64":
+                    ok = False                 # surrogate keys only
+                if (fkc.kind == "str") != (dkc.kind == "str"):
+                    ok = False
+                if len(es) > 1 and (fkc.kind == "str" or dkc.kind == "str"):
+                    ok = False                 # composite pack is int-only
+            if ok:
+                return fact_slot, dim_slot, fks, dks
         return None
 
     def _equi_pair(self, c, lcols, rcols):
@@ -1067,13 +1111,17 @@ class Planner:
                  if (plan := self._pk_gather_plan(
                      tables, sources, pair[0], pair[1], pes)) is not None),
                 (*next(iter(by_slots.items())), None))
+            got = None
             if gather is not None:
-                fact_slot, dim_slot, fk_name, dk_name = gather
+                fact_slot, dim_slot, fk_names, dk_names = gather
                 fact_t, dim_t = tables[fact_slot], tables[dim_slot]
-                r_idx, matched = E.pk_gather_join(
-                    fact_t[fk_name], dim_t[dk_name],
+                got = E.pk_gather_join_multi(
+                    [fact_t[n] for n in fk_names],
+                    [dim_t[n] for n in dk_names],
                     fact_t.nrows, dim_t.nrows,
                     f_excl=masks[fact_slot], d_excl=masks[dim_slot])
+            if got is not None:
+                r_idx, matched = got
                 cols = dict(fact_t.columns)
                 cols.update(E.gather_table_rows(
                     dim_t, r_idx, fact_t.nrows).columns)

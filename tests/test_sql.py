@@ -466,3 +466,40 @@ def test_inner_join_on_expression_equi_key():
     # synthetic keys must not leak into SELECT *
     r2 = s.sql("select * from a join b on (x * 2 = y)")
     assert set(r2.column_names) == {"x", "p", "y", "q"}
+
+
+def test_left_join_composite_pk_gather_null_extension():
+    """LEFT join on a declared composite PK runs as a gather with
+    null-extended misses; results must match semantics exactly, including
+    the IS NULL anti-join idiom (q78-class)."""
+    import pyarrow as pa
+    from nds_tpu.engine.session import Session
+    s = Session()
+    sales = pa.table({
+        "ss_item_sk": pa.array([1, 2, 3, 1], pa.int64()),
+        "ss_ticket_number": pa.array([10, 10, 20, 30], pa.int64()),
+        "ss_q": pa.array([5, 6, 7, 8], pa.int64()),
+    })
+    # store_returns with its spec composite PK (item, ticket) — register
+    # under the real name so the schema fact applies
+    returns = pa.table({
+        "sr_item_sk": pa.array([1, 3], pa.int64()),
+        "sr_ticket_number": pa.array([10, 20], pa.int64()),
+        "sr_amt": pa.array([100, 300], pa.int64()),
+    })
+    s.create_temp_view("store_sales", sales, base=True)
+    s.create_temp_view("store_returns", returns, base=True)
+    r = s.sql("""
+        select ss_item_sk, ss_ticket_number, ss_q, sr_amt
+        from store_sales
+        left join store_returns on sr_ticket_number = ss_ticket_number
+                                and ss_item_sk = sr_item_sk
+        order by ss_ticket_number, ss_item_sk""").collect()
+    assert r == [(1, 10, 5, 100), (2, 10, 6, None),
+                 (3, 20, 7, 300), (1, 30, 8, None)]
+    r2 = s.sql("""
+        select sum(ss_q) from store_sales
+        left join store_returns on sr_ticket_number = ss_ticket_number
+                                and ss_item_sk = sr_item_sk
+        where sr_ticket_number is null""").collect()
+    assert r2 == [(14,)]
