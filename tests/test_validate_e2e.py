@@ -1,0 +1,45 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""End-to-end validation-driver exercise: two Power Runs (exact decimal vs
+--floats) write per-query outputs, then nds_validate.py compares them at
+epsilon through its real CLI — the reference's acceptance-gate flow
+(ref: nds/nds_validate.py:48-260) driven exactly as a user would."""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+QUERIES = "query3,query42,query52,query96"
+
+
+def test_power_outputs_validate_across_decimal_and_floats(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", NDS_TPU_COMP_CACHE="force",
+               PYTHONPATH=REPO)
+    data = os.path.join(REPO, ".bench_cache", "sf0.01")
+    if not os.path.exists(os.path.join(data, ".complete")):
+        pytest.skip("SF0.01 cache not generated")
+    streams = tmp_path / "streams"
+    subprocess.run(
+        ["python3", os.path.join(REPO, "nds_gen_query_stream.py"),
+         "--streams", "1", "--rngseed", "77", "0.01", str(streams)],
+        check=True, env=env, cwd=REPO)
+    outs = {}
+    for tag, extra in (("dec", []), ("flt", ["--floats"])):
+        out = tmp_path / f"out_{tag}"
+        r = subprocess.run(
+            ["python3", os.path.join(REPO, "nds_power.py"), data,
+             str(streams / "query_0.sql"), str(tmp_path / f"time_{tag}.csv"),
+             "--input_format", "csv", "--output_prefix", str(out),
+             "--output_format", "parquet", "--sub_queries", QUERIES] + extra,
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=900)
+        assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+        outs[tag] = out
+        assert (out / "query3").exists()
+    r = subprocess.run(
+        ["python3", os.path.join(REPO, "nds_validate.py"),
+         str(outs["dec"]), str(outs["flt"]), str(streams / "query_0.sql"),
+         "--ignore_ordering", "--floats", "--sub_queries", QUERIES],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "MATCH" in r.stdout or "Pass" in r.stdout or r.returncode == 0
