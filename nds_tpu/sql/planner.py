@@ -124,13 +124,34 @@ class Planner:
         names: set = set()
         star = False
         # names that resolve to derived tables (CTEs) anywhere in the
-        # statement; a name that is ALSO a catalog table stays conservative
-        cte_names: set = set()
+        # statement, with their projected OUTPUT names (None when not
+        # statically derivable): a star over a CTE needs the CTE's output
+        # columns even though nothing references them (q47-class
+        # ``select * from v2`` where v2 projects aliased columns)
+        cte_outputs: dict = {}
+
+        def output_names(body):
+            if isinstance(body, A.Select):
+                outs = []
+                for i, it in enumerate(body.items):
+                    if it.alias:
+                        outs.append(it.alias.lower())
+                    elif isinstance(it.expr, A.ColumnRef):
+                        outs.append(it.expr.name.lower())
+                    elif isinstance(it.expr, A.FuncCall):
+                        outs.append(f"{it.expr.name}_{i}".lower())
+                    elif isinstance(it.expr, A.Star):
+                        return None          # expansion not static here
+                    else:
+                        outs.append(f"col{i}")
+                return outs
+            left = getattr(body, "left", None)
+            return output_names(left) if left is not None else None
 
         def collect_ctes(x):
             if isinstance(x, A.Query):
-                for cname, _ in x.ctes:
-                    cte_names.add(cname.lower())
+                for cname, cq in x.ctes:
+                    cte_outputs[cname.lower()] = output_names(cq.body)
             if hasattr(x, "__dataclass_fields__"):
                 for f in vars(x).values():
                     collect_any(f, collect_ctes)
@@ -168,7 +189,12 @@ class Planner:
                 if t is not None:
                     names.update(n.split(".")[-1].lower()
                                  for n in t.column_names)
-                elif name_l not in cte_names:
+                elif name_l in cte_outputs:
+                    outs = cte_outputs[name_l]
+                    if outs is None:
+                        return False          # CTE outputs not derivable
+                    names.update(outs)
+                else:
                     return False              # unknown leaf: stay safe
             return True
 
@@ -580,7 +606,8 @@ class Planner:
         if not residual and all_plain:
             l_on = [l for l, _ in equi]
             r_on = [r for _, r in equi]
-            if kind == "left" and right_src:
+            if kind == "left" and right_src and \
+                    not os.environ.get("NDS_TPU_NO_PK_GATHER"):
                 # LEFT join on the right side's declared (composite) PK:
                 # at most one match per probe row, so gather right columns
                 # onto the left's unchanged physical rows and null-extend

@@ -40,13 +40,29 @@ SCALE = os.environ.get("NDS_ORACLE_SCALE", "0.01")
 # queries SQLite executes faithfully after the interval rewrite (curated by
 # running --all and keeping those that parse AND parity-pass; dialect
 # mismatches, rollup/grouping sets and stddev stay out)
+# queries SQLite cannot faithfully evaluate, with the dialect reason —
+# excluded from discovery verdicts rather than reported as failures
+DIALECT_SKIPS = {
+    "query78": "integer '/' is C-style truncating division in SQLite; "
+               "Spark's '/' is true division (engine matches Spark)",
+}
+
 CURATED = [
-    "query1", "query3", "query6", "query7", "query9", "query13", "query15",
-    "query19", "query25", "query26", "query29", "query32", "query37",
-    "query41", "query42", "query43", "query45", "query46", "query48",
-    "query50", "query52", "query55", "query61", "query62", "query65",
-    "query68", "query73", "query79", "query84", "query85", "query88",
-    "query90", "query91", "query92", "query93", "query96", "query97",
+    "query1", "query2", "query3", "query4", "query6", "query7", "query8",
+    "query9", "query10", "query11", "query12", "query13", "query14_part2",
+    "query15", "query16", "query19", "query20", "query21", "query23_part1",
+    "query23_part2", "query24_part1", "query24_part2", "query25",
+    "query26", "query28", "query29", "query30", "query31", "query32",
+    "query33", "query34", "query35", "query37", "query38", "query40",
+    "query41", "query42", "query43", "query44", "query45", "query46",
+    "query47", "query48", "query49", "query50", "query51", "query52",
+    "query53", "query54", "query55", "query56", "query57", "query59",
+    "query60", "query61", "query62", "query63", "query64", "query65",
+    "query66", "query68", "query69", "query71", "query72", "query73",
+    "query74", "query75", "query76", "query79", "query81", "query82",
+    "query83", "query84", "query85", "query88", "query89", "query90",
+    "query91", "query92", "query93", "query94", "query95", "query96",
+    "query97", "query98", "query99",
 ]
 
 
@@ -104,11 +120,12 @@ def load_sqlite(data_dir: str):
 _CAST_INTERVAL_RE = re.compile(
     r"cast\s*\(\s*('[^']*')\s+as\s+date\s*\)\s*([+-])\s*"
     r"interval\s+(\d+)\s+days?", re.IGNORECASE)
-# bare cast-to-date must become date(): SQLite's CAST(x AS date) has
-# NUMERIC affinity ('2002-07-30' -> 2002), silently corrupting BETWEEN
-# bounds against TEXT date columns
+# cast-to-date must become date(): SQLite's CAST(x AS date) has NUMERIC
+# affinity ('2002-07-30' -> 2002 — true for literals AND for TEXT date
+# columns), silently corrupting comparisons. date() is the identity on
+# ISO text, so it is safe for both.
 _CAST_DATE_RE = re.compile(
-    r"cast\s*\(\s*('[^']*')\s+as\s+date\s*\)", re.IGNORECASE)
+    r"cast\s*\(\s*([^()]+?)\s+as\s+date\s*\)", re.IGNORECASE)
 _INTERVAL_RE = re.compile(
     r"([\w.]+)\s*([+-])\s*interval\s+(\d+)\s+days?", re.IGNORECASE)
 _CONCAT_RE = re.compile(r"\bconcat\s*\(", re.IGNORECASE)
@@ -251,6 +268,11 @@ def main():
 
     passed, failed, skipped = [], [], []
     for q in want:
+        if q in DIALECT_SKIPS:
+            skipped.append((q, DIALECT_SKIPS[q]))
+            print(f"SKIP {q:16s} dialect: {DIALECT_SKIPS[q][:80]}",
+                  flush=True)
+            continue
         sql = queries[q]
         try:
             oracle_rows = run_oracle(sql)
