@@ -182,10 +182,16 @@ def _exchange_join_step(mesh, cap_in: int, pair_cap: int, axis: str):
             # unmatchable rows with per-row sentinels); dead rows are
             # dropped before the exchange so they never consume capacity
             real = (h & jnp.uint64(4)) != 0
+            # dead rows route to bucket n_parts — past the last real bucket,
+            # so the argsort key IS dest and the sorted ``sd`` stays a valid
+            # searchsorted haystack (taking the raw dest, with dead rows at
+            # 0, left sd unsorted whenever dead rows existed and the binary
+            # search then misplaced real rows). Out-of-range sd drops out of
+            # both the scatter (mode="drop") and the segment_sum below.
             dest = jnp.where(real, hash_partition_dest(h, n_parts),
-                             jnp.int32(0))
+                             jnp.int32(n_parts))
             n = h.shape[0]
-            order = jnp.argsort(jnp.where(real, dest, jnp.int32(n_parts)))
+            order = jnp.argsort(dest)
             sd = jnp.take(dest, order)
             sreal = jnp.take(real, order)
             first = jnp.searchsorted(sd, sd, side="left")

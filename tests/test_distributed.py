@@ -195,6 +195,40 @@ def test_exchange_repartition_join_matches_single_device(monkeypatch):
     assert got == single.sql(sql).collect()
 
 
+def test_exchange_join_dead_rows():
+    """Sentinel (dead) rows — null keys, pad rows, deferred-filter exclusions
+    — must not corrupt the bucketize: regression for the unsorted-haystack
+    bug where dead rows kept dest=0 while the argsort key sent them to the
+    end, so searchsorted misplaced every real row once dead rows dominated
+    the binary-search midpoints (silently losing most join pairs)."""
+    from nds_tpu.parallel import exchange as X
+
+    mesh = make_mesh(8)
+    n = 4096
+    for frac in (0.5, 0.95):
+        rng_ = np.random.default_rng(3)
+        keys = rng_.integers(0, 200, n)
+        dead = rng_.random(n) < frac
+        row_ids = np.arange(n, dtype=np.uint64)
+        # _key_hash_impl sentinel layout: bits 0-1 side tag, bit 2 CLEAR,
+        # row id from bit 3; real hashes carry bit 2
+        lh = np.where(dead, (row_ids << 3) | 2,
+                      (keys.astype(np.uint64) << 3) | 4)
+        rh = np.where(dead, (row_ids << 3) | 1,
+                      (keys.astype(np.uint64) << 3) | 4)
+        rows = jnp.arange(n, dtype=jnp.int64)
+        li, ri, live = X.exchange_join_pairs(
+            jnp.asarray(lh), rows, jnp.asarray(rh), rows, mesh)
+        alive = keys[~dead]
+        expect = sum(int(c) * int(c) for c in np.bincount(alive))
+        assert int(jnp.sum(live)) == expect
+        # every returned pair must be a genuine key match between live rows
+        li_n = np.asarray(li)[np.asarray(live)]
+        ri_n = np.asarray(ri)[np.asarray(live)]
+        assert not dead[li_n].any() and not dead[ri_n].any()
+        assert (keys[li_n] == keys[ri_n]).all()
+
+
 def test_exchange_join_overflow_retry(monkeypatch):
     """Undersized initial capacities must be healed by the doubled-capacity
     retry, not lose rows."""
