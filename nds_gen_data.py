@@ -140,9 +140,22 @@ def generate_data_dist(args, tool_path, range_start, range_end):
     data_dir = _prepare_out_dir(args)
 
     # native runner (C++ host fan-out with retry, the MR wrapper's role;
-    # native/ndsrun) when built; the Python fan-out below is the fallback
-    ndsrun = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "native", "ndsrun", "ndsrun")
+    # native/ndsrun); the Python fan-out below is the fallback. Always
+    # (re)built from the checked-in source — an opaque prebuilt binary is
+    # never executed (it could silently drift from ndsrun.cc, and this
+    # path goes on to ssh-exec on remote hosts).
+    ndsrun_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "native", "ndsrun")
+    ndsrun = os.path.join(ndsrun_dir, "ndsrun")
+    if not os.environ.get("NDS_NO_NDSRUN"):
+        try:
+            build = subprocess.run(["make", "-C", ndsrun_dir],
+                                   capture_output=True, text=True)
+            err = build.stderr.strip() if build.returncode else ""
+        except OSError as e:              # no make on this host
+            err = str(e)
+        if err:
+            print(f"ndsrun build failed, using Python fan-out:\n{err}")
     if os.path.exists(ndsrun) and not os.environ.get("NDS_NO_NDSRUN"):
         cmd = [ndsrun, "-hosts", ",".join(host_list), "-scale", args.scale,
                "-parallel", str(args.parallel), "-dir", data_dir,

@@ -39,6 +39,16 @@ def check_build_ndsgen() -> Path:
     with reference data is required).
     """
     native = repo_root() / "native" / "ndsgen" / "ndsgen"
+    if not native.is_file():
+        # build from the checked-in source on demand (no prebuilt binary
+        # ships in the repo — it would be unreviewable and could drift);
+        # a host without make falls through to the $TPCDS_HOME toolkit
+        import subprocess
+        try:
+            subprocess.run(["make", "-C", str(native.parent)],
+                           capture_output=True, text=True)
+        except OSError:
+            pass
     if native.is_file() and os.access(native, os.X_OK):
         return native
     tpcds_home = os.environ.get("TPCDS_HOME")

@@ -64,16 +64,24 @@ class Column:
             return jnp.ones(self.data.shape[0], dtype=bool)
         return self.valid
 
-    def null_count(self, nrows: int | None = None) -> int:
+    def null_count(self, nrows=None) -> int:
         """Nulls among the first ``nrows`` rows (pass the table's logical
-        count for a bucket-padded column — pad slots carry garbage
-        validity)."""
+        count — host int or DeviceCount — for a bucket-padded column; pad
+        slots carry garbage validity). This is a host read: it syncs, and
+        the sync is counted."""
         if self.valid is None:
             return 0
+        from nds_tpu.engine import ops as _ops
         invalid = ~self.valid
-        if nrows is not None and nrows < int(self.data.shape[0]):
-            invalid = invalid & (jnp.arange(self.data.shape[0]) < nrows)
-        return int(jnp.sum(invalid))
+        # mask pads whenever the actual count may be below the physical
+        # length (always for a device count: its bound can equal plen while
+        # the true count is lower — pad slots carry cloned garbage validity)
+        if nrows is not None and (
+                isinstance(nrows, _ops.DeviceCount)
+                or int(nrows) < int(self.data.shape[0])):
+            invalid = invalid & (
+                jnp.arange(self.data.shape[0]) < _ops.count_arr(nrows))
+        return _ops.host_sync(jnp.sum(invalid))
 
     def take(self, indices) -> "Column":
         # clip mode: out-of-range pad indices duplicate a real row, so pad
@@ -230,6 +238,12 @@ def _slice_col(col: Column, nrows: int | None) -> Column:
                    valid=None if col.valid is None else col.valid[:nrows])
 
 
+def slice_col_prefix(col: Column, cap: int) -> Column:
+    """Public prefix slice — re-bucketing a lazily-compacted column down to
+    a resolved tight capacity (see ``ops.resolve_table``)."""
+    return _slice_col(col, cap)
+
+
 def column_to_arrow(col: Column, nrows: int | None = None) -> pa.Array:
     """Device -> arrow; ``nrows`` drops the padding before the transfer."""
     col = _slice_col(col, nrows)
@@ -271,18 +285,30 @@ def column_to_arrow(col: Column, nrows: int | None = None) -> pa.Array:
 def _fetch_columns(cols):
     """Materialize device buffers on host in ONE transfer round trip
     (``jax.device_get`` of the whole tree), returning Columns whose
-    data/valid are host numpy arrays."""
+    data/valid are host numpy arrays. Blocked time and bytes feed the
+    per-query roofline accounting (ops.sync_wait_ns / fetch_bytes)."""
+    import time as _time
+
     import jax
 
+    from nds_tpu.engine import ops as _ops
+
     tree = [(c.data, c.valid) for c in cols]
+    t0 = _time.perf_counter_ns()
     fetched = jax.device_get(tree)
+    _ops.add_sync_wait(_time.perf_counter_ns() - t0)
+    _ops.add_fetch_bytes(sum(
+        d.nbytes + (0 if v is None else v.nbytes) for d, v in fetched))
     return [replace(c, data=d, valid=v)
             for c, (d, v) in zip(cols, fetched)]
 
 
 def to_arrow(dt) -> pa.Table:
-    """DeviceTable -> arrow Table."""
-    cols = [_slice_col(c, dt.nrows) for c in dt.columns.values()]
+    """DeviceTable -> arrow Table. Crossing to host is THE legitimate
+    resolve point for a lazy count (DESIGN.md item 1)."""
+    from nds_tpu.engine import ops as _ops
+    nrows = _ops.count_int(dt.nrows)
+    cols = [_slice_col(c, nrows) for c in dt.columns.values()]
     cols = _fetch_columns(cols)   # one device->host round trip for the table
     arrays = [column_to_arrow(c) for c in cols]
     return pa.table(arrays, names=list(dt.columns.keys()))

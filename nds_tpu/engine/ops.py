@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -57,21 +59,155 @@ def bucket_len(n: int) -> int:
 
 # host-sync accounting: every device->host scalar read blocks the dispatch
 # queue (and under GSPMD is a full-mesh barrier through the host), so the
-# count per query is THE scalability number to watch (DESIGN.md). Reset /
-# read around a query by the drivers.
-sync_count = 0
+# count per query is THE scalability number to watch (DESIGN.md). Read
+# around a query by the drivers. Thread-local, matching the thread-scoped
+# listener: concurrent Throughput streams each count their own syncs.
+_sync_tls = threading.local()
+
+
+def add_syncs(n: int = 1) -> None:
+    """Charge ``n`` host syncs to the calling thread's stream."""
+    _sync_tls.count = getattr(_sync_tls, "count", 0) + n
+
+
+def sync_count() -> int:
+    """Host syncs counted on the calling thread so far."""
+    return getattr(_sync_tls, "count", 0)
+
+
+def add_sync_wait(ns: int) -> None:
+    """Charge nanoseconds spent BLOCKED on a device->host read (sync
+    stalls + result fetches) to the calling thread — the host side of the
+    roofline decomposition (everything else in a query's wall time is
+    dispatch + device compute overlap)."""
+    _sync_tls.wait_ns = getattr(_sync_tls, "wait_ns", 0) + ns
+
+
+def sync_wait_ns() -> int:
+    return getattr(_sync_tls, "wait_ns", 0)
+
+
+def add_fetch_bytes(n: int) -> None:
+    """Record device->host result bytes (collect()/to_arrow transfers)."""
+    _sync_tls.fetch_bytes = getattr(_sync_tls, "fetch_bytes", 0) + n
+
+
+def fetch_bytes() -> int:
+    return getattr(_sync_tls, "fetch_bytes", 0)
 
 
 def host_sync(value) -> int:
     """Read a device scalar on host, counting the sync."""
-    global sync_count
-    sync_count += 1
-    return int(value)
+    add_syncs()
+    t0 = time.perf_counter_ns()
+    out = int(value)
+    add_sync_wait(time.perf_counter_ns() - t0)
+    return out
 
 
-def live_mask(plen: int, nrows: int) -> jnp.ndarray:
-    """Bool mask of the logical (non-pad) prefix of a physical array."""
-    return jnp.arange(plen) < nrows
+class DeviceCount:
+    """A logical row count that stays on device (DESIGN.md reduction items
+    1+3: no-shrink capacity propagation with batched sync points).
+
+    Operators that merely need the count inside a traced computation
+    (liveness masks, hash-pad thresholds, segment routing) consume ``dev``
+    and never block. ``bound`` is the static upper bound — a filter or
+    inner join can never grow its input, so the producer's bucket is a
+    valid capacity for every consumer — used for all physical-shape
+    choices. Only a consumer that truly needs the host integer (ORDER
+    BY+LIMIT output, scalar subqueries, ``collect()``) resolves, and
+    resolution drains EVERY pending count of the calling thread in one
+    transfer: a join that would have cost three round trips (pairs + two
+    outer-extra counts) costs one.
+    """
+
+    __slots__ = ("dev", "bound", "_host")
+
+    def __init__(self, dev, bound: int):
+        self.dev = dev
+        self.bound = int(bound)
+        self._host: int | None = None
+        _pending_counts().append(self)
+
+    def to_int(self) -> int:
+        if self._host is None:
+            resolve_counts()
+        if self._host is None:
+            # not in the calling thread's pending list (created on another
+            # stream's thread) or an earlier drain failed mid-transfer:
+            # fetch directly rather than returning a poisoned None
+            add_syncs()
+            t0 = time.perf_counter_ns()
+            self._host = int(jax.device_get(self.dev))
+            add_sync_wait(time.perf_counter_ns() - t0)
+        return self._host
+
+    def __repr__(self):
+        state = self._host if self._host is not None else "?"
+        return f"DeviceCount({state}/{self.bound})"
+
+    # implicit coercions raise so every host consumer is an EXPLICIT,
+    # counted choice between count_int (syncs, batched) and count_bound
+    # (free): a silent int() here would be an uncounted round trip
+    def _no_host(self, *_a, **_k):
+        raise TypeError(
+            "DeviceCount is not a host value; use ops.count_int (syncs, "
+            "batched) or ops.count_bound (free upper bound)")
+
+    __bool__ = __index__ = __int__ = __eq__ = __lt__ = __le__ = __gt__ = \
+        __ge__ = __add__ = __radd__ = __mul__ = __rmul__ = _no_host
+    __hash__ = None
+
+
+def _pending_counts() -> list:
+    lst = getattr(_sync_tls, "pending", None)
+    if lst is None:
+        lst = _sync_tls.pending = []
+    return lst
+
+
+def resolve_counts() -> None:
+    """Fetch every pending device count of this thread in ONE transfer
+    (counted as one host sync — the batching is the point)."""
+    lst = _pending_counts()
+    pend = [c for c in lst if c._host is None]
+    if not pend:
+        lst.clear()
+        return
+    t0 = time.perf_counter_ns()
+    # on a failed transfer (device preemption) the list survives untouched,
+    # so a retry drains it instead of stranding unresolved counts
+    vals = jax.device_get([c.dev for c in pend])
+    add_sync_wait(time.perf_counter_ns() - t0)
+    add_syncs()
+    for c, v in zip(pend, vals):
+        c._host = int(v)
+    lst.clear()
+
+
+def count_int(n) -> int:
+    """Host integer of a count (resolves a DeviceCount, batched)."""
+    return n.to_int() if isinstance(n, DeviceCount) else int(n)
+
+
+def count_bound(n) -> int:
+    """Static upper bound of a count — valid for capacity decisions, free
+    of any sync. Exact when already host-resolved."""
+    if isinstance(n, DeviceCount):
+        return n.bound if n._host is None else n._host
+    return int(n)
+
+
+def count_arr(n):
+    """Traced-use form: the device scalar (or the plain int — both are
+    valid jit arguments)."""
+    return n.dev if isinstance(n, DeviceCount) else n
+
+
+def live_mask(plen: int, nrows) -> jnp.ndarray:
+    """Bool mask of the logical (non-pad) prefix of a physical array.
+    ``nrows`` may be a host int or a :class:`DeviceCount` (no sync)."""
+    return jnp.arange(plen) < count_arr(nrows)
 
 
 def compact_indices(mask: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -83,12 +219,58 @@ def compact_indices(mask: jnp.ndarray, n: int) -> jnp.ndarray:
     return jnp.nonzero(mask, size=cap, fill_value=max(plen, 1))[0]
 
 
-def compact_table(table: DeviceTable, mask: jnp.ndarray) -> DeviceTable:
-    """Keep rows where ``mask`` is true, re-bucketing to a prefix-padded
-    table. The single host sync is the row count."""
+# lazy-compaction bucket ceiling: below it, carrying the un-shrunk bucket
+# is cheaper than a device->host round trip (the round trip dominates on a
+# tunneled chip and is a full-mesh barrier under GSPMD); above it, the
+# resolve-and-slice pays for itself in downstream sort width
+_LAZY_SHRINK_ROWS = int(os.environ.get("NDS_TPU_LAZY_SHRINK_ROWS",
+                                       str(1 << 20)))
+
+
+def compact_table(table: DeviceTable, mask: jnp.ndarray,
+                  shrink: bool = False) -> DeviceTable:
+    """Keep rows where ``mask`` is true, as a prefix-padded table.
+
+    Default (``shrink=False``, DESIGN.md item 1): NO host sync — live rows
+    gather to the prefix of a bucket sized from the producer's bound (a
+    filter never grows its input) and the logical count rides along as a
+    :class:`DeviceCount`. Downstream joins/aggregations are pad-tolerant,
+    so only an output-shaping consumer ever resolves it, batched.
+
+    ``shrink=True`` is the legacy eager mode — one (batched) host sync,
+    re-bucketing to the tight capacity — for callers about to hold many
+    compacted tables at once (load-time filters, chunk accumulation)."""
     m = mask & live_mask(table.plen, table.nrows)
-    n = host_sync(jnp.sum(m))
-    return take_padded(table, compact_indices(m, n), n)
+    if shrink:
+        n = host_sync(jnp.sum(m))
+        return take_padded(table, compact_indices(m, n), n)
+    cap = min(bucket_len(count_bound(table.nrows)), bucket_len(table.plen))
+    idx = jnp.nonzero(m, size=cap, fill_value=max(table.plen, 1))[0]
+    n = DeviceCount(jnp.sum(m), min(count_bound(table.nrows), cap))
+    out = take_padded(table, idx, n)
+    if cap > _LAZY_SHRINK_ROWS:
+        # adaptive: past this bucket size the downstream sorts/segment ops a
+        # fat bucket drags through cost more than one (batched) round trip,
+        # so resolve now — the transfer still drains the whole pending batch
+        return resolve_table(out)
+    return out
+
+
+def resolve_table(table: DeviceTable, shrink: bool = True) -> DeviceTable:
+    """Resolve a table's lazy count to a host int (batched — one transfer
+    drains every pending count of the thread) and, by default, slice the
+    physical bucket down to the tight capacity. Lazy compaction kept live
+    rows in the prefix, so shrinking is a metadata-cheap device slice."""
+    n = table.nrows
+    if not isinstance(n, DeviceCount):
+        return table
+    ni = n.to_int()
+    cap = bucket_len(ni)
+    if not shrink or cap >= table.plen:
+        return DeviceTable(table.columns, ni, plen=table.plen)
+    from nds_tpu.engine.column import slice_col_prefix
+    cols = {nm: slice_col_prefix(c, cap) for nm, c in table.columns.items()}
+    return DeviceTable(cols, ni, plen=cap)
 
 
 @jax.jit
@@ -135,11 +317,14 @@ def take_padded(table: DeviceTable, idx: jnp.ndarray, nrows: int) -> DeviceTable
 # ---------------------------------------------------------------------------
 
 
-def _identity_cache(cache: dict, max_size: int, key_arrays: tuple, compute):
-    """Bounded FIFO cache keyed by the identity of host arrays. The entry
-    holds references to the keyed arrays so a recycled id() can never alias
-    a freed object; evicts oldest-first past ``max_size``."""
-    key = tuple(id(a) for a in key_arrays)
+def _identity_cache(cache: dict, max_size: int, key_arrays: tuple, compute,
+                    static_key=()):
+    """Bounded FIFO cache keyed by the identity of host arrays (plus an
+    optional hashable ``static_key`` for non-array parameters the cached
+    value depends on). The entry holds references to the keyed arrays so a
+    recycled id() can never alias a freed object; evicts oldest-first past
+    ``max_size``."""
+    key = (static_key,) + tuple(id(a) for a in key_arrays)
     hit = cache.get(key)
     if hit is not None and all(h is a for h, a in zip(hit[0], key_arrays)):
         return hit[1]
@@ -242,11 +427,15 @@ def lexsort_indices(cols, descending=None, nulls_last=None,
         descending = [False] * len(cols)
     if nulls_last is None:
         nulls_last = [False] * len(cols)
-    pad_key = n_valid is not None and n_valid < n
+    # a device count may sit below the physical length; the pad sort key is
+    # harmless when they happen to be equal, so lazily-counted tables always
+    # take it (no sync)
+    pad_key = n_valid is not None and (
+        isinstance(n_valid, DeviceCount) or n_valid < n)
     views = tuple(sortable_view(c) for c in cols)
     valids = tuple(c.valid for c in cols)
     return _lexsort_impl(views, valids, tuple(descending), tuple(nulls_last),
-                         pad_key, 0 if n_valid is None else n_valid)
+                         pad_key, 0 if n_valid is None else count_arr(n_valid))
 
 
 # ---------------------------------------------------------------------------
@@ -361,12 +550,13 @@ def _packed_group_plan(key_cols, views, n_valid):
         elif c.kind == "bool":
             spans[i] = (0, 1)
     if int_idx:
-        global sync_count
         mins, maxs = _int_key_ranges(
             tuple(views[i] for i in int_idx), n_valid)
-        sync_count += 1
+        add_syncs()
+        t0 = time.perf_counter_ns()
         mins = np.asarray(mins)
         maxs = np.asarray(maxs)
+        add_sync_wait(time.perf_counter_ns() - t0)
         for k, i in enumerate(int_idx):
             if mins[k] > maxs[k]:              # no live rows
                 spans[i] = (0, 0)
@@ -415,17 +605,21 @@ def group_ids(key_cols, n_valid: int | None = None):
                 jnp.full(cap, 1, dtype=jnp.int64), cap)
     views = tuple(sortable_view(c) for c in key_cols)
     valids = tuple(c.valid for c in key_cols)
+    nv = count_arr(n_valid)
     plan = None
     if len(key_cols) > 1 and plen >= _PACK_MIN_PLEN:
-        plan = _packed_group_plan(key_cols, views, n_valid)
+        plan = _packed_group_plan(key_cols, views, nv)
     if plan is not None:
         gids, ng_dev = _group_ids_packed(views, valids, plan[0], plan[1],
-                                         n_valid)
+                                         nv)
     else:
-        gids, ng_dev = _group_ids_impl(views, valids, n_valid)
-    ngroups = host_sync(ng_dev)                      # the one host sync
+        gids, ng_dev = _group_ids_impl(views, valids, nv)
+    # the one host sync — routed through the pending batch, so any lazy
+    # counts the query accumulated upstream (filter compactions, inner-join
+    # pair counts) resolve in the SAME transfer
+    ngroups = DeviceCount(ng_dev, count_bound(n_valid)).to_int()
     cap = bucket_len(ngroups)
-    rep = _group_rep_impl(gids, n_valid, cap)
+    rep = _group_rep_impl(gids, nv, cap)
     return gids, ngroups, rep, cap
 
 
@@ -750,8 +944,10 @@ def _probe_candidates(left_keys, right_keys, null_safe=False,
     lviews, rviews = _hash_views(left_keys, right_keys)
     lvalids = tuple(c.valid for c in left_keys)
     rvalids = tuple(c.valid for c in right_keys)
-    lh = _key_hash_impl(lviews, lvalids, 0, null_safe, n_left, l_excl)
-    rh = _key_hash_impl(rviews, rvalids, 1, null_safe, n_right, r_excl)
+    lh = _key_hash_impl(lviews, lvalids, 0, null_safe, count_arr(n_left),
+                        l_excl)
+    rh = _key_hash_impl(rviews, rvalids, 1, null_safe, count_arr(n_right),
+                        r_excl)
     order = jnp.argsort(rh)
     rh_sorted = jnp.take(rh, order)
     lo = jnp.searchsorted(rh_sorted, lh, side="left")
@@ -791,8 +987,14 @@ def join_indices(left_keys, right_keys, how: str = "inner",
         pair_live = live_mask(cand, total)
         ok = _verify_pairs(l_idx, r_idx, left_keys, right_keys, null_safe)
         ok = ok & pair_live
-        n_pairs = host_sync(jnp.sum(ok))               # host sync 2
-        keep = jnp.nonzero(ok, size=bucket_len(n_pairs), fill_value=cand)[0]
+        # NO pair-count sync: verified pairs compact to the prefix of the
+        # candidate bucket (the verify only removes hash collisions, so the
+        # bucket is near-tight) and the exact count rides as a DeviceCount.
+        # An outer join resolves it below — batched with the extra counts
+        # into ONE transfer (DESIGN.md item 3) — because the concatenated
+        # output layout needs host offsets; an inner join never syncs here.
+        n_pairs = DeviceCount(jnp.sum(ok), total)
+        keep = jnp.nonzero(ok, size=cand, fill_value=cand)[0]
         # out-of-range pads: point pad pairs past both inputs
         l_idx = jnp.take(l_idx, keep, mode="fill", fill_value=plen_l)
         r_idx = jnp.take(r_idx, keep, mode="fill", fill_value=plen_r)
@@ -804,21 +1006,27 @@ def join_indices(left_keys, right_keys, how: str = "inner",
 
     l_extra = r_extra = None
     n_lx = n_rx = 0
+    miss = miss_r = None
     if how in ("left", "full"):
         matched = jnp.zeros(plen_l, dtype=bool).at[l_idx].set(
             True, mode="drop")
         miss = ~matched & live_mask(plen_l, n_left)
         if l_excl is not None:
             miss = miss & ~l_excl
-        n_lx = host_sync(jnp.sum(miss))
-        l_extra = compact_indices(miss, n_lx)
+        n_lx = DeviceCount(jnp.sum(miss), count_bound(n_left))
     if how in ("right", "full"):
         matched_r = jnp.zeros(plen_r, dtype=bool).at[r_idx].set(
             True, mode="drop")
         miss_r = ~matched_r & live_mask(plen_r, n_right)
         if r_excl is not None:
             miss_r = miss_r & ~r_excl
-        n_rx = host_sync(jnp.sum(miss_r))
+        n_rx = DeviceCount(jnp.sum(miss_r), count_bound(n_right))
+    # one batched transfer resolves every count this join created
+    if miss is not None:
+        n_lx = n_lx.to_int()
+        l_extra = compact_indices(miss, n_lx)
+    if miss_r is not None:
+        n_rx = n_rx.to_int()
         r_extra = compact_indices(miss_r, n_rx)
     return l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx
 
@@ -907,7 +1115,11 @@ def _dense_dim_info(dim_key: Column, n_dim: int):
         pos[live - mn] = np.arange(n_dim)
         return mn, jnp.asarray(pos)
 
-    return _identity_cache(_dense_dim_cache, 64, (dim_key.data,), compute)
+    # n_dim in the key: the position map's miss marker and coverage are
+    # built for one logical row count, so a re-probe of the same array at a
+    # different n_dim must not reuse a stale map
+    return _identity_cache(_dense_dim_cache, 64, (dim_key.data,), compute,
+                           static_key=n_dim)
 
 
 @jax.jit
@@ -945,6 +1157,11 @@ def pk_gather_join(fact_key: Column, dim_key: Column,
     comparable integer views (merged dictionary ranks for string pairs),
     and takes the dense-range position-map probe when the dimension key
     is a dense unique integer range (all TPC-DS surrogate keys)."""
+    # the dense position map is HOST-built per dimension, so a lazy dim
+    # count resolves here (batched); dimensions are load-time tables with
+    # host counts on every hot path, so this stays sync-free in practice
+    if isinstance(n_dim, DeviceCount):
+        n_dim = n_dim.to_int()
     if fact_key.kind == "str" and dim_key.kind == "str":
         fview, dview = ordered_codes_merged(fact_key, dim_key)
     else:
@@ -954,9 +1171,9 @@ def pk_gather_join(fact_key: Column, dim_key: Column,
             base, pos_map = dense
             return _pk_gather_dense_impl(
                 fview, fact_key.valid, dview, dim_key.valid, pos_map,
-                jnp.int64(base), n_fact, n_dim, f_excl, d_excl)
+                jnp.int64(base), count_arr(n_fact), n_dim, f_excl, d_excl)
     return _pk_gather_impl(fview, fact_key.valid, dview, dim_key.valid,
-                           n_fact, n_dim, f_excl, d_excl)
+                           count_arr(n_fact), n_dim, f_excl, d_excl)
 
 
 _dim_span_cache: dict = {}
@@ -994,13 +1211,16 @@ def pk_gather_join_multi(fact_keys, dim_keys, n_fact: int, n_dim: int,
     kinds = {c.kind for c in list(fact_keys) + list(dim_keys)}
     if any(k in ("str", "f64") or k.startswith("dec") for k in kinds):
         return None
+    if isinstance(n_dim, DeviceCount):      # host span plan (see above)
+        n_dim = n_dim.to_int()
 
     def compute():
-        global sync_count
         mins, maxs = _int_key_ranges(
             tuple(c.data for c in dim_keys), n_dim)
-        sync_count += 1
+        add_syncs()
+        t0 = time.perf_counter_ns()
         mins, maxs = np.asarray(mins), np.asarray(maxs)
+        add_sync_wait(time.perf_counter_ns() - t0)
         offsets, widths, spans, total = [], [], [], 0
         for lo, hi in zip(mins, maxs):
             span = max(int(hi) - int(lo), 0)
@@ -1014,7 +1234,8 @@ def pk_gather_join_multi(fact_keys, dim_keys, n_fact: int, n_dim: int,
         return tuple(offsets), tuple(widths), tuple(spans)
 
     plan = _identity_cache(_dim_span_cache, 128,
-                           tuple(c.data for c in dim_keys), compute)
+                           tuple(c.data for c in dim_keys), compute,
+                           static_key=n_dim)
     if plan is None:
         return None
     offsets, widths, spans = plan
@@ -1024,8 +1245,8 @@ def pk_gather_join_multi(fact_keys, dim_keys, n_fact: int, n_dim: int,
     dpacked, dok = _pack_keys_impl(
         tuple(c.data for c in dim_keys),
         tuple(c.valid for c in dim_keys), offsets, widths, spans)
-    return _pk_gather_impl(fpacked, fok, dpacked, dok, n_fact, n_dim,
-                           f_excl, d_excl)
+    return _pk_gather_impl(fpacked, fok, dpacked, dok, count_arr(n_fact),
+                           n_dim, f_excl, d_excl)
 
 
 def _null_column_like(col: Column, n: int) -> Column:
@@ -1125,9 +1346,9 @@ def _exchange_inner_join(left, right, left_keys, right_keys, mesh,
     plen_r = len(right_keys[0])
     lviews, rviews = _hash_views(left_keys, right_keys)
     lh = _key_hash_impl(lviews, tuple(c.valid for c in left_keys), 0,
-                        False, left.nrows, l_excl)
+                        False, count_arr(left.nrows), l_excl)
     rh = _key_hash_impl(rviews, tuple(c.valid for c in right_keys), 1,
-                        False, right.nrows, r_excl)
+                        False, count_arr(right.nrows), r_excl)
     l_idx_x, r_idx_x, live = exchange_join_pairs(
         lh, jnp.arange(plen_l, dtype=jnp.int64),
         rh, jnp.arange(plen_r, dtype=jnp.int64), mesh)
@@ -1280,7 +1501,9 @@ def concat_tables(tables) -> DeviceTable:
     in one fused dispatch (string columns pre-align their dictionaries on
     host)."""
     names = tables[0].column_names
-    total = sum(t.nrows for t in tables)
+    # physical concatenation lays parts out with host offsets, so lazy
+    # counts must resolve here — all parts in ONE batched transfer
+    total = sum(count_int(t.nrows) for t in tables)
     if not names:
         return DeviceTable({}, total, plen=max(bucket_len(total), total))
 
@@ -1298,7 +1521,7 @@ def concat_tables(tables) -> DeviceTable:
         parts_valids.append(vs)
         metas.append((n, kind, dict_values))
 
-    part_nrows = tuple(t.nrows for t in tables)
+    part_nrows = tuple(count_int(t.nrows) for t in tables)
     datas, valids, live = _concat_cols_impl(
         tuple(parts_datas), tuple(parts_valids), part_nrows)
     out = {}
@@ -1328,8 +1551,10 @@ def sort_table(table: DeviceTable, keys, descending=None, nulls_last=None) -> De
 
 
 def limit_table(table: DeviceTable, n: int) -> DeviceTable:
-    """First ``n`` logical rows (callers sort first; pads always trail)."""
-    new_n = min(n, table.nrows)
+    """First ``n`` logical rows (callers sort first; pads always trail).
+    LIMIT is output-shaping: a lazy count legitimately resolves here
+    (batched), per DESIGN.md item 1's consumer taxonomy."""
+    new_n = min(n, count_int(table.nrows))
     cap = bucket_len(new_n)
     if cap >= table.plen:
         return DeviceTable(dict(table.columns), new_n)

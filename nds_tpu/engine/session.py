@@ -28,7 +28,8 @@ class Result:
 
     @property
     def num_rows(self) -> int:
-        return self.table.nrows
+        from nds_tpu.engine import ops as E
+        return E.count_int(self.table.nrows)
 
     @property
     def column_names(self):
@@ -189,6 +190,9 @@ class Session:
     def sql(self, text: str) -> Result:
         stmt = parse(text)
         planner = Planner(self.catalog, base_tables=self.base_tables)
+        # roofline accounting: bytes of every catalog table the statement
+        # binds (read by the Power Run's per-query summaries)
+        self.last_scanned = planner.scanned
         if isinstance(stmt, A.Query):
             return Result(planner.query(stmt))
         if isinstance(stmt, A.CreateTempView):
@@ -220,7 +224,9 @@ class Session:
                 mask = planner._conjunct_mask(aliased,
                                               planner._split_conjuncts(stmt.where))
                 keep_mask = ~mask
-            kept = E.compact_table(table, keep_mask)
+            # maintenance boundary: shrink eagerly — the kept table is
+            # re-registered and written back, so tight buckets pay off
+            kept = E.compact_table(table, keep_mask, shrink=True)
             self.warehouse.overwrite(stmt.table, kept.to_arrow())
             self.create_temp_view(stmt.table, kept)
             return Result(DeviceTable({}, 0))

@@ -57,7 +57,15 @@ class WindowContext:
             self.part_boundary = _boundaries(partition_cols, self.order)
         else:
             self.part_boundary = jnp.zeros(self.n, dtype=bool).at[0].set(True)
-        if 0 < n_valid < self.n:
+        # wall off the pad suffix into its own partition. A device count
+        # applies the traced form unconditionally: at n_valid == n the
+        # boundary lands past every row (no-op), at 0 it re-marks row 0.
+        from nds_tpu.engine.ops import DeviceCount, count_arr
+        if isinstance(n_valid, DeviceCount):
+            if self.n:
+                self.part_boundary = self.part_boundary | (
+                    pos == count_arr(n_valid))
+        elif 0 < n_valid < self.n:
             self.part_boundary = self.part_boundary | (pos == n_valid)
         self.gid_sorted = jnp.cumsum(self.part_boundary) - 1
         # segment capacity: physical length is a static upper bound on the

@@ -182,7 +182,9 @@ def run_query_stream(input_prefix: str,
             trace_ctx = _prof.trace(os.path.join(profile_folder, query_name))
             trace_ctx.__enter__()
         from nds_tpu.engine import ops as _ops
-        syncs_before = _ops.sync_count
+        syncs_before = _ops.sync_count()
+        wait_before = _ops.sync_wait_ns()
+        fetch_before = _ops.fetch_bytes()
         try:
             elapsed = q_report.report_on(run_one_query, session, q_content,
                                          query_name, output_path,
@@ -190,10 +192,24 @@ def run_query_stream(input_prefix: str,
         finally:
             if trace_ctx is not None:
                 trace_ctx.__exit__(None, None, None)
-        # per-query host-sync count: each is a dispatch-queue flush (and a
-        # full-mesh barrier under GSPMD) — the scalability number DESIGN.md
-        # tracks
-        q_report.summary["hostSyncs"] = _ops.sync_count - syncs_before
+        # roofline decomposition (DESIGN.md / SURVEY §5.1): host syncs are
+        # dispatch-queue flushes (full-mesh barriers under GSPMD);
+        # syncWaitMs is the wall time BLOCKED on device->host reads — the
+        # rest of the wall overlaps dispatch with device compute; scanBytes
+        # over wall time yields the effective scan bandwidth to hold
+        # against the chip's HBM roofline
+        q_report.summary["hostSyncs"] = _ops.sync_count() - syncs_before
+        sync_ms = (_ops.sync_wait_ns() - wait_before) / 1e6
+        q_report.summary["syncWaitMs"] = round(sync_ms, 3)
+        q_report.summary["fetchBytes"] = _ops.fetch_bytes() - fetch_before
+        scanned = getattr(session, "last_scanned", {})
+        scan_bytes = sum(scanned.values())
+        q_report.summary["scanBytes"] = scan_bytes
+        if elapsed > 0:
+            q_report.summary["scanGBps"] = round(
+                scan_bytes / (elapsed / 1e3) / 1e9, 3)
+            q_report.summary["syncWaitPct"] = round(
+                100.0 * sync_ms / elapsed, 1)
         print(f"Time taken: [{elapsed}] millis for {query_name}")
         execution_time_list.append((session.app_id, query_name, elapsed))
         q_report.summary["query"] = query_name

@@ -62,10 +62,6 @@ class EvalCtx:
     post_agg: bool = False
 
 
-def _nrows(ctx: EvalCtx) -> int:
-    return ctx.table.nrows
-
-
 class _StreamedScan:
     """A >HBM base-table scan inside a join graph: the host-resident
     ChunkedTable plus its FROM alias. :func:`Planner._stream_join_parts`
@@ -92,6 +88,16 @@ class _StreamedScan:
         return planner._alias_table(self.chunked.materialize(), self.alias)
 
 
+def _table_bytes(t) -> int:
+    """Resident byte size of a catalog table (device columns or a
+    host-resident ChunkedTable) — the scanBytes term of the per-query
+    roofline accounting."""
+    if hasattr(t, "nbytes"):               # ChunkedTable
+        return int(t.nbytes)
+    return sum(c.data.nbytes + (0 if c.valid is None else c.valid.nbytes)
+               for c in t.columns.values())
+
+
 class Planner:
     def __init__(self, catalog: dict, base_tables: set | None = None):
         self.catalog = catalog          # name -> (DeviceTable with plain col names)
@@ -104,6 +110,9 @@ class Planner:
         # (projection pushdown); None = pruning disabled (SELECT * present
         # or not yet computed)
         self._needed_names: set | None = None
+        # roofline accounting: catalog tables this statement actually bound,
+        # with their resident byte sizes (per-query scanBytes in summaries)
+        self.scanned: dict[str, int] = {}
 
     # ------------------------------------------------------------------ query
 
@@ -132,19 +141,7 @@ class Planner:
 
         def output_names(body):
             if isinstance(body, A.Select):
-                outs = []
-                for i, it in enumerate(body.items):
-                    if it.alias:
-                        outs.append(it.alias.lower())
-                    elif isinstance(it.expr, A.ColumnRef):
-                        outs.append(it.expr.name.lower())
-                    elif isinstance(it.expr, A.FuncCall):
-                        outs.append(f"{it.expr.name}_{i}".lower())
-                    elif isinstance(it.expr, A.Star):
-                        return None          # expansion not static here
-                    else:
-                        outs.append(f"col{i}")
-                return outs
+                return self._projected_names(body.items)
             left = getattr(body, "left", None)
             return output_names(left) if left is not None else None
 
@@ -316,7 +313,7 @@ class Planner:
         raise ExecError(f"unsupported set expression {type(body).__name__}")
 
     def _distinct(self, t: DeviceTable) -> DeviceTable:
-        if t.nrows == 0:
+        if E.count_bound(t.nrows) == 0:
             return t
         gids, ng, rep, cap = E.group_ids([t[n] for n in t.column_names],
                                          n_valid=t.nrows)
@@ -328,10 +325,12 @@ class Planner:
         for scope in reversed(self.cte_stack):
             if name.lower() in scope:
                 return scope[name.lower()]
-        if name.lower() in self.catalog:
-            return self.catalog[name.lower()]
-        if name in self.catalog:
-            return self.catalog[name]
+        key = name.lower() if name.lower() in self.catalog else name
+        if key in self.catalog:
+            t = self.catalog[key]
+            if key not in self.scanned:
+                self.scanned[key] = _table_bytes(t)
+            return t
         raise ExecError(f"unknown table {name!r}")
 
     def _alias_table(self, t: DeviceTable, alias: str) -> DeviceTable:
@@ -646,24 +645,30 @@ class Planner:
         if kind == "inner":
             return matched
         out_parts = [matched]
+        miss = miss_r = None
         if kind in ("left", "full"):
             safe_l = jnp.where(keep_mask, l_idx, left.plen)
             lmask = jnp.zeros(left.plen, dtype=bool).at[safe_l].set(
                 True, mode="drop")
             miss = ~lmask & E.live_mask(left.plen, left.nrows)
-            n_lx = int(jnp.sum(miss))
+            nd_lx = E.DeviceCount(jnp.sum(miss), E.count_bound(left.nrows))
+        if kind in ("right", "full"):
+            safe_r = jnp.where(keep_mask, r_idx, right.plen)
+            rmask = jnp.zeros(right.plen, dtype=bool).at[safe_r].set(
+                True, mode="drop")
+            miss_r = ~rmask & E.live_mask(right.plen, right.nrows)
+            nd_rx = E.DeviceCount(jnp.sum(miss_r), E.count_bound(right.nrows))
+        # both extra counts resolve in one batched transfer (one sync)
+        if miss is not None:
+            n_lx = nd_lx.to_int()
             if n_lx:
                 lx = E.compact_indices(miss, n_lx)
                 cols = {n: c.take(lx) for n, c in left.columns.items()}
                 cols.update({n: E._null_column_like(c, int(lx.shape[0]))
                              for n, c in right.columns.items()})
                 out_parts.append(DeviceTable(cols, n_lx))
-        if kind in ("right", "full"):
-            safe_r = jnp.where(keep_mask, r_idx, right.plen)
-            rmask = jnp.zeros(right.plen, dtype=bool).at[safe_r].set(
-                True, mode="drop")
-            miss_r = ~rmask & E.live_mask(right.plen, right.nrows)
-            n_rx = int(jnp.sum(miss_r))
+        if miss_r is not None:
+            n_rx = nd_rx.to_int()
             if n_rx:
                 rx = E.compact_indices(miss_r, n_rx)
                 cols = {n: E._null_column_like(c, int(rx.shape[0]))
@@ -816,7 +821,9 @@ class Planner:
 
     def _cartesian(self, left: DeviceTable, right: DeviceTable) -> DeviceTable:
         pl, pr = left.plen, right.plen
-        nl, nr = left.nrows, right.nrows
+        # the physical expansion is pl x pr either way; host counts lay out
+        # the live prefix (both sides resolve in one batched transfer)
+        nl, nr = E.count_int(left.nrows), E.count_int(right.nrows)
         total = nl * nr
         if pl == 0 or pr == 0 or total == 0:
             cols = {n: E._null_column_like(c, E.bucket_len(0))
@@ -1021,7 +1028,7 @@ class Planner:
             sub[keep] = chunk
             out = self._join_parts(sub, join_preds, where_conjuncts,
                                    list(sources))
-            if out.nrows or not outs:
+            if E.count_bound(out.nrows) or not outs:
                 outs.append(out)
         return E.concat_tables(outs) if len(outs) > 1 else outs[0]
 
@@ -1231,6 +1238,38 @@ class Planner:
             out = self._distinct(out)
         return out
 
+    @staticmethod
+    def _item_name(item, i: int) -> str:
+        """Output name of one non-star SELECT item BEFORE collision
+        renaming. Single source of truth for _project and the pruning
+        side's _projected_names — they must never disagree, or projection
+        pruning drops a column the star over a CTE still needs."""
+        name = item.alias
+        if name is None:
+            if isinstance(item.expr, A.ColumnRef):
+                name = item.expr.name.lower()
+            elif isinstance(item.expr, A.FuncCall):
+                name = f"{item.expr.name}_{i}"
+            else:
+                name = f"col{i}"
+        return name.lower()
+
+    @classmethod
+    def _projected_names(cls, items):
+        """The exact output names :meth:`_project` will emit for a SELECT
+        list — including the duplicate-name ``_{i}`` suffixing — or None
+        when not statically derivable (a star expansion depends on the
+        input table, so callers must disable pruning)."""
+        outs: list = []
+        for i, item in enumerate(items):
+            if isinstance(item.expr, A.Star):
+                return None
+            name = cls._item_name(item, i)
+            if name in outs:
+                name = f"{name}_{i}"
+            outs.append(name)
+        return outs
+
     def _project(self, sel: A.Select, ctx: EvalCtx) -> DeviceTable:
         cols = {}
         for i, item in enumerate(sel.items):
@@ -1241,15 +1280,7 @@ class Planner:
                     base = n.split(".")[-1]
                     cols[base if base not in cols else n] = c
                 continue
-            name = item.alias
-            if name is None:
-                if isinstance(item.expr, A.ColumnRef):
-                    name = item.expr.name.lower()
-                elif isinstance(item.expr, A.FuncCall):
-                    name = f"{item.expr.name}_{i}"
-                else:
-                    name = f"col{i}"
-            name = name.lower()
+            name = self._item_name(item, i)
             if name in cols:
                 name = f"{name}_{i}"
             col = self.eval_expr(item.expr, ctx)
@@ -1303,12 +1334,16 @@ class Planner:
         if set_tables is not None:
             pass
         else:
+            # SQL's empty-input semantics (a GLOBAL aggregate over zero rows
+            # still yields one row) need the exact count, not the bound; the
+            # resolve is batched with every lazy count pending upstream
+            n_input = E.count_int(table.nrows)
             set_tables = []
             for gset in group_by.sets:
                 gset_keys = [expr_key(e) for e in gset]
                 active = [key_cols[i] for i, k in enumerate(key_names)
                           if k in gset_keys]
-                if table.nrows == 0:
+                if n_input == 0:
                     # empty input: global agg still yields one row
                     if active or group_by.kind != "plain" or group_exprs:
                         continue
@@ -1323,7 +1358,7 @@ class Planner:
                                      0, cap).astype(jnp.int64)
                     rep = jnp.zeros(cap, dtype=jnp.int64)
                 group_cols = {
-                    k: (key_cols[i].take(rep) if table.nrows
+                    k: (key_cols[i].take(rep) if n_input
                         else X.literal(None, cap))
                     for i, k in enumerate(key_names) if k in gset_keys}
                 # aggregates (segment capacity = cap keeps shapes canonical;
@@ -1403,7 +1438,7 @@ class Planner:
         partial/final aggregation. Engages when every aggregate is
         algebraically decomposable (sum/count/avg/min/max, no DISTINCT);
         returns None to fall back to the per-set generic path."""
-        if group_by.kind != "rollup" or table.nrows == 0:
+        if group_by.kind != "rollup" or E.count_int(table.nrows) == 0:
             return None
         if not agg_calls or not all(
                 c.name in self._ROLLUP_REAGG and not c.distinct
@@ -1508,7 +1543,8 @@ class Planner:
 
     def _compute_agg(self, call: A.FuncCall, base_ctx: EvalCtx, gids, ng, key_cols):
         name = call.name
-        n_base = base_ctx.table.nrows
+        # memoized by the _aggregate-time resolve: no extra sync here
+        n_base = E.count_int(base_ctx.table.nrows)
         if name == "count" and call.star:
             return E.agg_count(None, gids, ng)
         arg = self.eval_expr(call.args[0], base_ctx) if call.args else None
@@ -2010,7 +2046,7 @@ class Planner:
         found = self._find_correlation(e.query, ctx)
         if found is None:
             t = self.query(e.query)
-            val = t.nrows > 0
+            val = E.count_int(t.nrows) > 0
             res = Column("bool", jnp.full(n, val, dtype=bool))
             return X.logical_not(res) if e.negated else res
         corr, stripped, residual = found
@@ -2110,9 +2146,10 @@ class Planner:
         if found is None:
             rt = self.query(e.query)
             col = rt[rt.column_names[0]]
-            if rt.nrows == 0:
+            n_rt = E.count_int(rt.nrows)     # host semantics: exact count
+            if n_rt == 0:
                 return X.literal(None, n)
-            if rt.nrows != 1:
+            if n_rt != 1:
                 raise ExecError("scalar subquery returned more than one row")
             data = jnp.broadcast_to(col.data[0], (n,))
             valid = None
@@ -2142,7 +2179,9 @@ class Planner:
         # may match at most once; more than one match means the original
         # subquery was not scalar per outer row
         hits = jnp.zeros(n, dtype=jnp.int32).at[l_idx].add(1, mode="drop")
-        if n_pairs and int(jnp.max(hits)) > 1:
+        # pad pairs drop out of the scatter, so max(hits) alone detects a
+        # non-scalar subquery; one counted, batch-draining host read
+        if E.DeviceCount(jnp.max(hits), n).to_int() > 1:
             raise ExecError("correlated scalar subquery returned more than one "
                             "row per outer row")
         data = jnp.zeros(n, dtype=val_col.data.dtype)
@@ -2161,7 +2200,7 @@ class Planner:
         rt = self.query(e.query)
         col = rt[rt.column_names[0]]
         lhs = self.eval_expr(e.expr, ctx)
-        if rt.nrows == 0:
+        if E.count_int(rt.nrows) == 0:
             val = e.quantifier == "all"
             return Column("bool", jnp.full(n, val, dtype=bool))
         # live rows reduce into segment 0; pads go to the dropped segment

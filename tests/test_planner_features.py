@@ -157,6 +157,24 @@ class TestOrderByAggregateItem:
         assert rows == [(3,)]
 
 
+def test_star_over_cte_with_colliding_names():
+    """Projection pruning must keep the collision-suffixed duplicate
+    column (``_project`` renames the second ``x`` to ``x_3``-style): a CTE
+    projecting the same bare name from two tables, then ``SELECT *`` over
+    it, silently lost the renamed column when the pruning side guessed
+    output names without modeling the rename."""
+    s = _session()
+    rows = s.sql("""
+        with j as (
+            select sales.s_item, dim.d_sk, sales.s_amt amt, dim.d_cat amt
+            from sales, dim where s_item = d_sk
+        )
+        select * from j order by s_item, d_sk""").collect()
+    # every projected column survives: s_item, d_sk, amt, amt_3 (renamed)
+    assert all(len(r) == 4 for r in rows)
+    assert rows[0] == (100, 100, 5.0, "a")
+
+
 def test_rollup_hierarchy_matches_generic_path(monkeypatch):
     """The hierarchical rollup re-aggregation must reproduce the per-set
     generic path exactly: nulls in keys and args, empty groups, string
