@@ -150,6 +150,8 @@ def run_server():
                 canonical_types={f.name: f.type for f in fields})
     print(json.dumps({"ready": True}), flush=True)
 
+    from nds_tpu.engine import ops as _ops
+
     for line in sys.stdin:
         name = line.strip()
         if not name:
@@ -164,11 +166,24 @@ def run_server():
             t0 = time.perf_counter()
             sess.sql(sql).collect()
             t1 = time.perf_counter()
+            # roofline decomposition measured on the final pass (sync
+            # counts are deterministic per query; wait time is weather)
+            s0, w0 = _ops.sync_count(), _ops.sync_wait_ns()
             sess.sql(sql).collect()
-            ms = min(t1 - t0, time.perf_counter() - t1) * 1000.0
-            print(f"# {name}: warm {t0 - tw:.1f}s timed {ms/1000:.2f}s",
+            t2 = time.perf_counter()
+            ms = min(t1 - t0, t2 - t1) * 1000.0
+            syncs = _ops.sync_count() - s0
+            sync_ms = (_ops.sync_wait_ns() - w0) / 1e6
+            scan = sum(getattr(sess, "last_scanned", {}).values())
+            gbps = scan / max(t2 - t1, 1e-9) / 1e9
+            print(f"# {name}: warm {t0 - tw:.1f}s timed {ms/1000:.2f}s "
+                  f"syncs {syncs} syncWait {sync_ms:.0f}ms "
+                  f"scan {gbps:.2f}GB/s",
                   file=sys.stderr)
-            print(json.dumps({"name": name, "ms": ms}), flush=True)
+            print(json.dumps({
+                "name": name, "ms": ms, "hostSyncs": syncs,
+                "syncWaitMs": round(sync_ms, 1), "scanBytes": scan,
+                "scanGBps": round(gbps, 3)}), flush=True)
         except Exception as e:                        # keep serving
             print(json.dumps({"name": name,
                               "error": f"{type(e).__name__}: {e}"[:300]}),
@@ -273,6 +288,33 @@ class ChildServer:
         self.proc = None
 
 
+def write_perf(times, perf):
+    """PERF.md: the per-query roofline table (wall, host-sync count and
+    blocked time, bytes scanned, effective bandwidth) the geomean headline
+    decomposes into. Committed alongside BENCH_r{N}.json so 'is it fast?'
+    is answerable from artifacts (device vs host split per query)."""
+    if not perf:
+        return
+    rows = sorted(times)
+    tot_sync = sum(p.get("syncWaitMs", 0) for p in perf.values())
+    tot_ms = sum(times.values())
+    with open(os.path.join(REPO, "PERF.md"), "w") as f:
+        f.write("# Power Run roofline decomposition\n\n")
+        f.write(f"Scale factor {SCALE}; warm min-of-2 wall times on the "
+                "attached chip.\n"
+                f"Aggregate: {len(times)} queries, "
+                f"{tot_sync / max(tot_ms, 1e-9) * 100:.1f}% of summed wall "
+                "time blocked on device->host reads.\n\n")
+        f.write("| query | wall ms | host syncs | sync wait ms | "
+                "scan MB | scan GB/s |\n|---|---|---|---|---|---|\n")
+        for q in rows:
+            p = perf.get(q, {})
+            f.write(f"| {q} | {times[q]:.0f} | {p.get('hostSyncs', '-')} | "
+                    f"{p.get('syncWaitMs', '-')} | "
+                    f"{p.get('scanBytes', 0) / 1e6:.1f} | "
+                    f"{p.get('scanGBps', '-')} |\n")
+
+
 _emitted = False
 
 
@@ -303,6 +345,7 @@ def run_parent(t_entry):
     # margin so the final JSON + baseline write always beat an external kill
     margin_s = 20.0
     times = {}
+    perf = {}
     names = []
     child = ChildServer()
 
@@ -344,6 +387,9 @@ def run_parent(t_entry):
             continue
         if "ms" in msg:
             times[msg["name"]] = msg["ms"]
+            perf[msg["name"]] = {k: msg[k] for k in
+                                 ("hostSyncs", "syncWaitMs", "scanBytes",
+                                  "scanGBps") if k in msg}
         else:
             print(f"# {name} failed: {msg.get('error')}", file=sys.stderr)
     child.stop()
@@ -351,6 +397,7 @@ def run_parent(t_entry):
     if times and len(times) < len(names):
         print(f"# measured {len(times)}/{len(names)} queries",
               file=sys.stderr)
+    write_perf(times, perf)
     emit(times, len(names))
     if not times:
         sys.exit(1)

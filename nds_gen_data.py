@@ -147,6 +147,7 @@ def generate_data_dist(args, tool_path, range_start, range_end):
     ndsrun_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "native", "ndsrun")
     ndsrun = os.path.join(ndsrun_dir, "ndsrun")
+    ndsrun_ok = False
     if not os.environ.get("NDS_NO_NDSRUN"):
         try:
             build = subprocess.run(["make", "-C", ndsrun_dir],
@@ -155,8 +156,12 @@ def generate_data_dist(args, tool_path, range_start, range_end):
         except OSError as e:              # no make on this host
             err = str(e)
         if err:
+            # a failed build must NOT fall back to a stale binary — that
+            # would ssh-exec code that no longer matches ndsrun.cc
             print(f"ndsrun build failed, using Python fan-out:\n{err}")
-    if os.path.exists(ndsrun) and not os.environ.get("NDS_NO_NDSRUN"):
+        else:
+            ndsrun_ok = os.path.exists(ndsrun)
+    if ndsrun_ok:
         cmd = [ndsrun, "-hosts", ",".join(host_list), "-scale", args.scale,
                "-parallel", str(args.parallel), "-dir", data_dir,
                "-range", f"{range_start},{range_end}",

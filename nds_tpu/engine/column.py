@@ -18,6 +18,7 @@ unmasked where the zero is the identity.
 
 from __future__ import annotations
 
+import functools
 import re
 from dataclasses import dataclass, replace
 
@@ -282,6 +283,17 @@ def column_to_arrow(col: Column, nrows: int | None = None) -> pa.Array:
     return pa.array(data_np, type=pa_type, mask=mask)
 
 
+@functools.lru_cache(maxsize=8)
+def _replicator(mesh):
+    """One cached jitted identity-with-replicated-output per mesh, so
+    multi-host fetches retrace once instead of per column per collect."""
+    import jax
+
+    from jax.sharding import NamedSharding, PartitionSpec
+    rep = NamedSharding(mesh, PartitionSpec())
+    return jax.jit(lambda a: a, out_shardings=rep)
+
+
 def _fetch_columns(cols):
     """Materialize device buffers on host in ONE transfer round trip
     (``jax.device_get`` of the whole tree), returning Columns whose
@@ -295,6 +307,18 @@ def _fetch_columns(cols):
 
     tree = [(c.data, c.valid) for c in cols]
     t0 = _time.perf_counter_ns()
+
+    def _addressable(x):
+        if x is None or isinstance(x, np.ndarray):
+            return x
+        if getattr(x, "is_fully_addressable", True):
+            return x
+        # multi-controller federation: shards live on other processes'
+        # devices; an explicit replicate (all-gather over DCN) makes the
+        # value locally readable — the multi-host leg of collect()
+        return _replicator(x.sharding.mesh)(x)
+
+    tree = [(_addressable(d), _addressable(v)) for d, v in tree]
     fetched = jax.device_get(tree)
     _ops.add_sync_wait(_time.perf_counter_ns() - t0)
     _ops.add_fetch_bytes(sum(

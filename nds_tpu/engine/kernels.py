@@ -182,6 +182,124 @@ def segment_sum_fused(weights, gids, num_segments: int):
 
 
 # ---------------------------------------------------------------------------
+# EXACT int64 segment-sum via limb-split MXU matmuls (the decimal path)
+# ---------------------------------------------------------------------------
+#
+# The default bench runs exact decimals (scaled int64), which the f32 MXU
+# kernel above cannot carry (24-bit mantissa). Two's-complement limb
+# decomposition makes it exact for ANY int64 — no trust in declared value
+# bounds: limbs 0-6 are unsigned bytes, the top limb is the SIGNED
+# arithmetic shift (v >> 56, in [-128, 127]), so v = sum_l limb_l << 8l
+# identically. All 8 limbs plus the count row ride ONE (9, TR) x (TR, TG)
+# MXU matmul per grid cell (the systolic array processes the 9-row operand
+# in the same tile pass as the 1-row f32 kernel's). A per-cell partial is
+# <= 512*255 < 2^17 so the f32 dot is exact; cross-tile accumulation
+# happens in an i32 output ref (exact while n*255 < 2^31 => n < 2^23 rows
+# — the one gate), and the i64 recombination runs in XLA on the tiny
+# (9, G) result, wrapping on true-sum overflow exactly like the XLA
+# segment-sum it replaces.
+
+_LIMB_BITS = 8
+_N_LIMBS = 8            # full int64 coverage: 7 unsigned bytes + signed top
+
+
+def _seg_exact_kernel(gid_ref, w_ref, acc_ref):
+    """One (group-tile j, row-tile i) cell: (9, TR) limb rows (+count
+    row) hit the one-hot membership block in a single MXU matmul; the f32
+    partial (exact, < 2^17) accumulates into the i32 output ref."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    gid = gid_ref[:]                      # (1, TR) i32, -1 = masked row
+    j = pl.program_id(0)
+    groups = j * _TG + jax.lax.broadcasted_iota(jnp.int32, (_TR, _TG), 1)
+    onehot = (gid.reshape(_TR, 1) == groups).astype(jnp.float32)
+    part = jnp.dot(w_ref[:], onehot, preferred_element_type=jnp.float32)
+    acc_ref[:] += part.astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _segment_sum_exact_pallas(gids, values, num_segments: int,
+                              interpret: bool):
+    n = gids.shape[0]
+    k = _N_LIMBS
+    live = gids >= 0
+    v = jnp.where(live, values, 0)
+    n_pad = max(_ceil_to(n, _TR), _TR)
+    g_pad = max(_ceil_to(num_segments, _TG), _TG)
+    gid_p = jnp.full(n_pad, -1, dtype=jnp.int32).at[:n].set(
+        gids.astype(jnp.int32))
+    rows = []
+    for l in range(k - 1):
+        limb = (v >> (_LIMB_BITS * l)) & jnp.int64(255)
+        rows.append(jnp.zeros(n_pad, dtype=jnp.float32).at[:n].set(
+            limb.astype(jnp.float32)))
+    top = v >> (_LIMB_BITS * (k - 1))              # signed, [-128, 127]
+    rows.append(jnp.zeros(n_pad, dtype=jnp.float32).at[:n].set(
+        top.astype(jnp.float32)))
+    rows.append(jnp.zeros(n_pad, dtype=jnp.float32).at[:n].set(
+        live.astype(jnp.float32)))                 # count row
+    w = jnp.stack(rows)                            # (k+1, n_pad)
+    grid = (g_pad // _TG, n_pad // _TR)            # rows innermost
+    acc = pl.pallas_call(
+        _seg_exact_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _TR), lambda j, i: (j - j, i)),
+            pl.BlockSpec((k + 1, _TR), lambda j, i: (j - j, i)),
+        ],
+        out_specs=pl.BlockSpec((k + 1, _TG), lambda j, i: (i - i, j)),
+        out_shape=jax.ShapeDtypeStruct((k + 1, g_pad), jnp.int32),
+        interpret=interpret,
+    )(gid_p.reshape(1, n_pad), w)
+    acc = acc[:, :num_segments].astype(jnp.int64)
+    sums = jnp.zeros(num_segments, dtype=jnp.int64)
+    for l in range(k):
+        sums = sums + (acc[l] << (_LIMB_BITS * l))
+    return sums, acc[k]
+
+
+def exact_sum_supported(num_segments: int, n_rows: int) -> bool:
+    """True when the exact limb-split kernel will engage: Pallas active
+    for this group count and per-limb i32 accumulation cannot overflow."""
+    return pallas_active(num_segments) and n_rows < (1 << 23)
+
+
+def segment_sum_exact(values, gids, num_segments: int):
+    """EXACT (sums i64[G], counts i64[G]) of any int64 ``values`` grouped
+    by ``gids`` (rows with gid < 0 excluded). MXU limb path on TPU under
+    the same gates as :func:`segment_sum_fused`; XLA segment ops
+    elsewhere. Unlike the f32 kernel this is bit-exact — it serves the
+    DEFAULT decimal bench path."""
+    global _pallas_broken
+    mode = _pallas_mode()
+    if mode != "off" and not _pallas_broken and \
+            exact_sum_supported(num_segments, int(values.shape[0])):
+        try:
+            sums, counts = _segment_sum_exact_pallas(
+                gids, values, num_segments, mode == "interpret")
+            return sums, counts.astype(jnp.int64)
+        except Exception as e:  # Mosaic unsupported on this attachment
+            _pallas_broken = True
+            from nds_tpu.listener import report_task_failure
+            report_task_failure("pallas exact segment-sum kernel "
+                                "(permanent XLA fallback)", e)
+            import sys
+            print("# pallas kernels disabled; using XLA fallback",
+                  file=sys.stderr)
+    live = gids >= 0
+    safe = jnp.where(live, gids, 0)
+    v = jnp.where(live, values, 0)
+    sums = jax.ops.segment_sum(v, safe, num_segments=num_segments)
+    counts = jax.ops.segment_sum(live.astype(jnp.int64), safe,
+                                 num_segments=num_segments)
+    return sums, counts
+
+
+# ---------------------------------------------------------------------------
 # segment min/max (VPU tiled reduce over the same one-hot membership tiling)
 # ---------------------------------------------------------------------------
 

@@ -685,6 +685,18 @@ def agg_sum(col: Column, gids, ngroups) -> Column:
             return Column("f64", sums.astype(jnp.float64), counts > 0)
         out, nonempty = _agg_sum_impl(col.data, col.valid, gids, ngroups, True)
         return Column("f64", out, nonempty)
+    if is_dec(col.kind):
+        # EXACT MXU path for the default decimal bench: two's-complement
+        # limb accumulation (kernels.segment_sum_exact), bit-exact for any
+        # int64 — no reliance on the declared precision.
+        from nds_tpu.engine.kernels import (exact_sum_supported,
+                                            segment_sum_exact)
+        if exact_sum_supported(ngroups, int(gids.shape[0])):
+            valid = col.valid_mask()
+            g = jnp.where(valid, gids, -1)
+            sums, counts = segment_sum_exact(
+                jnp.where(valid, col.data, 0), g, ngroups)
+            return Column(f"dec(38,{col.scale})", sums, counts > 0)
     out, nonempty = _agg_sum_impl(col.data, col.valid, gids, ngroups, False)
     kind = f"dec(38,{col.scale})" if is_dec(col.kind) else "i64"
     return Column(kind, out, nonempty)
@@ -745,6 +757,21 @@ def _agg_avg_impl(data, valid, gids, ngroups):
 
 
 def agg_avg(col: Column, gids, ngroups) -> Column:
+    if is_dec(col.kind):
+        # exact MXU sum first (same gate as agg_sum), then one f64 divide:
+        # better than accumulating rounded f64 terms AND rides the hardware
+        from nds_tpu.engine.kernels import (exact_sum_supported,
+                                            segment_sum_exact)
+        if exact_sum_supported(ngroups, int(gids.shape[0])):
+            valid = col.valid_mask()
+            g = jnp.where(valid, gids, -1)
+            sums, counts = segment_sum_exact(
+                jnp.where(valid, col.data, 0), g, ngroups)
+            out = jnp.where(
+                counts > 0,
+                (sums.astype(jnp.float64) / (10.0 ** col.scale)) /
+                jnp.maximum(counts, 1).astype(jnp.float64), 0.0)
+            return Column("f64", out, counts > 0)
     data = col.data.astype(jnp.float64)
     if is_dec(col.kind):
         data = data / (10.0 ** col.scale)

@@ -103,3 +103,83 @@ def test_segment_minmax_group_gate(monkeypatch):
     assert float(mins[5]) == 3.0 and float(maxs[5]) == 7.0
     assert not kernels.pallas_active(6)
     assert kernels.pallas_active(4)
+
+
+# ---------------------------------------------------------------------------
+# exact limb-split segment sum (the DEFAULT decimal bench path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n,groups", [(1, 1), (1000, 130), (5000, 513),
+                                      (4096, 2048)])
+def test_segment_sum_exact_interpret(interpret_mode, n, groups):
+    """Bit-exact parity with a host int accumulation, including negative
+    values at the full dec(7,2) domain and masked rows."""
+    rng = np.random.default_rng(5)
+    gids = rng.integers(-1, groups, size=n).astype(np.int32)
+    v = rng.integers(-(10 ** 7 - 1), 10 ** 7, size=n).astype(np.int64)
+    sums, counts = kernels.segment_sum_exact(
+        jnp.asarray(v), jnp.asarray(gids), groups)
+    ref_s = np.zeros(groups, dtype=np.int64)
+    ref_c = np.zeros(groups, dtype=np.int64)
+    for x, g in zip(v, gids):
+        if g >= 0:
+            ref_s[g] += x
+            ref_c[g] += 1
+    np.testing.assert_array_equal(np.asarray(sums), ref_s)
+    np.testing.assert_array_equal(np.asarray(counts), ref_c)
+
+
+def test_segment_sum_exact_extremes(interpret_mode):
+    """Every row at the domain extreme, one group: the worst case for
+    limb-accumulator width (n * 255 per limb) must stay exact."""
+    n = 8192
+    # far past any decimal precision: exactness must not depend on any
+    # declared value bound (two's-complement limbs cover all of int64)
+    v = np.full(n, (1 << 52) + 12345, dtype=np.int64)
+    v[::2] = -(1 << 52) - 99999
+    gids = np.zeros(n, dtype=np.int32)
+    sums, counts = kernels.segment_sum_exact(
+        jnp.asarray(v), jnp.asarray(gids), 1)
+    assert int(sums[0]) == int(v.sum())
+    assert int(counts[0]) == n
+
+
+def test_exact_gate_declines_out_of_bounds(interpret_mode):
+    assert not kernels.exact_sum_supported(kernels._MAX_GROUPS + 1, 100)
+    assert not kernels.exact_sum_supported(100, 1 << 23)     # too many rows
+    assert kernels.exact_sum_supported(100, 100)
+
+
+def test_agg_sum_decimal_rides_exact_kernel(interpret_mode):
+    """The engine's DEFAULT (exact decimal) aggregation must produce
+    bit-identical results through the kernel and the XLA path."""
+    import os
+
+    from nds_tpu.engine import ops as E
+    from nds_tpu.engine.column import Column
+
+    rng = np.random.default_rng(9)
+    n, groups = 3000, 40
+    gids = jnp.asarray(rng.integers(0, groups, n))
+    data = jnp.asarray(rng.integers(-10 ** 6, 10 ** 6, n), dtype=jnp.int64)
+    valid = jnp.asarray(rng.random(n) < 0.9)
+    col = Column("dec(7,2)", jnp.where(valid, data, 0), valid)
+    via_kernel = E.agg_sum(col, gids, groups)
+    os.environ["NDS_TPU_PALLAS"] = "off"
+    try:
+        via_xla = E.agg_sum(col, gids, groups)
+    finally:
+        os.environ["NDS_TPU_PALLAS"] = "interpret"
+    np.testing.assert_array_equal(np.asarray(via_kernel.data),
+                                  np.asarray(via_xla.data))
+    np.testing.assert_array_equal(np.asarray(via_kernel.valid),
+                                  np.asarray(via_xla.valid))
+    via_avg = E.agg_avg(col, gids, groups)
+    os.environ["NDS_TPU_PALLAS"] = "off"
+    try:
+        via_avg_xla = E.agg_avg(col, gids, groups)
+    finally:
+        os.environ["NDS_TPU_PALLAS"] = "interpret"
+    np.testing.assert_allclose(np.asarray(via_avg.data),
+                               np.asarray(via_avg_xla.data), rtol=1e-12)

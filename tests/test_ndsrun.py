@@ -72,6 +72,46 @@ open(os.path.join({str(log)!r}, "ok_" + rng.replace(",", "_")), "w").close()
     assert "failed for range 4,6" in r.stderr
 
 
+def test_ssh_launcher_argv_contract(tmp_path):
+    """The default ssh launcher must exec `ssh <host> <python> <driver>
+    local ...` — covered with a stub ssh on PATH that records its argv and
+    runs the remote command locally (no sshd needed)."""
+    log = tmp_path / "log"
+    log.mkdir()
+    sshlog = tmp_path / "ssh_calls"
+    stub_dir = tmp_path / "bin"
+    stub_dir.mkdir()
+    stub = stub_dir / "ssh"
+    stub.write_text(f"""#!/usr/bin/env python3
+import subprocess, sys
+with open({str(sshlog)!r}, "a") as f:
+    f.write(" ".join(sys.argv[1:]) + "\\n")
+# argv[1] is the host; the rest is the remote command
+sys.exit(subprocess.run(sys.argv[2:]).returncode)
+""")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    drv = tmp_path / "driver.py"
+    _write_driver(drv, f"""
+import sys, os
+args = sys.argv[1:]
+rng = args[args.index("--range") + 1]
+open(os.path.join({str(log)!r}, "ok_" + rng.replace(",", "_")), "w").close()
+""")
+    env = dict(os.environ, PATH=f"{stub_dir}:{os.environ['PATH']}")
+    r = subprocess.run(
+        [NDSRUN, "-hosts", "hostA,hostB", "-scale", "1", "-parallel", "4",
+         "-dir", str(tmp_path / "out"),
+         "-python", "python3", "-driver", str(drv)],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    assert {f.name for f in log.iterdir()} == {"ok_1_2", "ok_3_4"}
+    calls = sshlog.read_text().splitlines()
+    hosts = {c.split()[0] for c in calls}
+    assert hosts == {"hostA", "hostB"}
+    for c in calls:
+        assert "python3" in c and str(drv) in c and "local 1 4" in c
+
+
 def test_permanently_failing_span_exits_nonzero(tmp_path):
     drv = tmp_path / "driver.py"
     _write_driver(drv, "import sys; sys.exit(1)\n")

@@ -42,27 +42,35 @@ SCALE = os.environ.get("NDS_ORACLE_SCALE", "0.01")
 # mismatches, rollup/grouping sets and stddev stay out)
 # queries SQLite cannot faithfully evaluate, with the dialect reason —
 # excluded from discovery verdicts rather than reported as failures
-DIALECT_SKIPS = {
-    "query78": "integer '/' is C-style truncating division in SQLite; "
-               "Spark's '/' is true division (engine matches Spark)",
-}
+# (query78's truncating-division mismatch is gone: the AST emitter forces
+# REAL division with a *1.0 factor, matching Spark's true division)
+DIALECT_SKIPS: dict = {}
 
+# the full 103-query corpus. The AST emitter (tools/sqlite_emit.py) closed
+# the former rollup/grouping-sets/stddev/division gaps; q16/q18/q64 carry
+# SQLite plans that need a raised NDS_ORACLE_TIMEOUT_S (q18 passed at
+# 1500s; q64's 19-relation cross_sales join has not finished under any
+# budget/join-order tried — the one residual oracle gap, covered instead
+# by mesh parity + decimal/float cross-validation).
 CURATED = [
-    "query1", "query2", "query3", "query4", "query6", "query7", "query8",
-    "query9", "query10", "query11", "query12", "query13", "query14_part2",
-    "query15", "query16", "query19", "query20", "query21", "query23_part1",
-    "query23_part2", "query24_part1", "query24_part2", "query25",
-    "query26", "query28", "query29", "query30", "query31", "query32",
-    "query33", "query34", "query35", "query37", "query38", "query40",
+    "query1", "query2", "query3", "query4", "query5", "query6", "query7",
+    "query8", "query9", "query10", "query11", "query12", "query13",
+    "query14_part1", "query14_part2", "query15", "query16", "query17",
+    "query18", "query19", "query20", "query21", "query22",
+    "query23_part1", "query23_part2", "query24_part1", "query24_part2",
+    "query25", "query26", "query27", "query28", "query29", "query30",
+    "query31", "query32", "query33", "query34", "query35", "query36",
+    "query37", "query38", "query39_part1", "query39_part2", "query40",
     "query41", "query42", "query43", "query44", "query45", "query46",
     "query47", "query48", "query49", "query50", "query51", "query52",
-    "query53", "query54", "query55", "query56", "query57", "query59",
-    "query60", "query61", "query62", "query63", "query64", "query65",
-    "query66", "query68", "query69", "query71", "query72", "query73",
-    "query74", "query75", "query76", "query79", "query81", "query82",
-    "query83", "query84", "query85", "query88", "query89", "query90",
-    "query91", "query92", "query93", "query94", "query95", "query96",
-    "query97", "query98", "query99",
+    "query53", "query54", "query55", "query56", "query57", "query58",
+    "query59", "query60", "query61", "query62", "query63", "query64",
+    "query65", "query66", "query67", "query68", "query69", "query70",
+    "query71", "query72", "query73", "query74", "query75", "query76",
+    "query77", "query78", "query79", "query80", "query81", "query82",
+    "query83", "query84", "query85", "query86", "query87", "query88",
+    "query89", "query90", "query91", "query92", "query93", "query94",
+    "query95", "query96", "query97", "query98", "query99",
 ]
 
 
@@ -213,6 +221,53 @@ def engine_date_to_text(rows, column_kinds):
     return out
 
 
+def oracle_script(sql):
+    """AST emitter first (rollup/grouping-sets expansion, stddev closed
+    form, CTEs materialized as indexed temp tables); the older textual
+    rewrite remains the fallback for anything the emitter declines."""
+    from tools.sqlite_emit import to_sqlite_script
+    try:
+        return to_sqlite_script(sql)
+    except Exception:
+        return [to_sqlite_sql(sql)]
+
+
+def execute_oracle(con, sql, timeout_s=None):
+    """Run one query's oracle script on ``con`` with a deadline: CTEs
+    materialize as surrogate-key-indexed temp tables (dropped after), and
+    the final statement's rows come back."""
+    import threading
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("NDS_ORACLE_TIMEOUT_S", "120"))
+    timer = threading.Timer(timeout_s, con.interrupt)
+    timer.start()
+    temp_tables = []
+    try:
+        stmts = oracle_script(sql)
+        for stmt in stmts[:-1]:
+            if stmt.startswith("--index-sk:"):
+                tname = stmt.split(":", 1)[1]
+                cols = [r[1] for r in con.execute(
+                    f'PRAGMA table_info("{tname}")')]
+                for c in cols:
+                    if c.endswith("_sk") or c == "item_sk":
+                        con.execute(
+                            f'create index if not exists '
+                            f'"ix_tmp_{tname}_{c}" on "{tname}"("{c}")')
+                continue
+            if stmt.startswith("create temp table "):
+                temp_tables.append(stmt.split()[3])
+            con.execute(stmt)
+        return con.execute(stmts[-1]).fetchall()
+    finally:
+        timer.cancel()
+        for t in temp_tables:   # temp names must not shadow base
+            try:                # tables for later queries
+                con.execute(f"drop table if exists {t}")
+            except sqlite3.Error:
+                pass
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--queries", help="comma list; default = curated set")
@@ -220,21 +275,58 @@ def main():
                     help="attempt every generated query (discovery mode)")
     args = ap.parse_args()
 
-    os.environ["NDS_SWEEP_SCALE"] = SCALE
-    from tools.coverage_sweep import ensure_data
+    import json
+
     from nds_tpu.queries import generate_query_streams
     from nds_tpu.power import gen_sql_from_stream
     from nds_tpu.engine.session import Session
     from nds_tpu.schema import get_schemas
 
-    data_dir = ensure_data()
-    stream_dir = os.path.join(REPO, ".bench_cache", "oracle_stream")
-    os.makedirs(stream_dir, exist_ok=True)
-    stream_file = os.path.join(stream_dir, "query_0.sql")
-    if not os.path.exists(stream_file):
-        generate_query_streams(stream_dir, streams=1, rngseed=19620718,
-                               scale=float(SCALE))
-    queries = gen_sql_from_stream(stream_file)
+    # per-query parameter overrides (seed and/or scale) chosen so curated
+    # queries return non-empty results — a zero-row parity pass exercises
+    # predicates, not aggregation/join semantics (VERDICT r2 weak #4)
+    params_file = os.path.join(REPO, "tools", "oracle_params.json")
+    overrides = {}
+    if os.path.exists(params_file):
+        overrides = json.load(open(params_file)).get("overrides", {})
+
+    default_seed = 19620718
+    _ctx: dict = {}          # scale -> (sqlite con, engine session)
+    _streams: dict = {}      # (scale, seed) -> {query: sql}
+
+    def ctx(scale: str):
+        if scale not in _ctx:
+            os.environ["NDS_SWEEP_SCALE"] = scale
+            import importlib
+
+            import tools.coverage_sweep as CS
+            importlib.reload(CS)
+            data_dir = CS.ensure_data()
+            con = load_sqlite(data_dir)
+            session = Session()
+            for tname, fields in get_schemas(use_decimal=True).items():
+                path = os.path.join(data_dir, f"{tname}.dat")
+                if os.path.exists(path):
+                    session.read_raw_view(tname, path, fields)
+            _ctx[scale] = (con, session)
+        return _ctx[scale]
+
+    def stream(scale: str, seed: int):
+        if (scale, seed) not in _streams:
+            if seed == default_seed and scale == SCALE:
+                d = os.path.join(REPO, ".bench_cache", "oracle_stream")
+            else:
+                d = os.path.join(REPO, ".bench_cache",
+                                 f"oracle_stream_s{seed}_sf{scale}")
+            os.makedirs(d, exist_ok=True)
+            f = os.path.join(d, "query_0.sql")
+            if not os.path.exists(f):
+                generate_query_streams(d, streams=1, rngseed=seed,
+                                       scale=float(scale))
+            _streams[(scale, seed)] = gen_sql_from_stream(f)
+        return _streams[(scale, seed)]
+
+    queries = stream(SCALE, default_seed)
     if args.queries:
         want = [q.strip() for q in args.queries.split(",")]
     elif args.all:
@@ -246,36 +338,22 @@ def main():
         print(f"not in stream: {missing}", file=sys.stderr)
     want = [q for q in want if q in queries]
 
-    con = load_sqlite(data_dir)
-    session = Session()
-    for tname, fields in get_schemas(use_decimal=True).items():
-        path = os.path.join(data_dir, f"{tname}.dat")
-        if os.path.exists(path):
-            session.read_raw_view(tname, path, fields)
-
-    import threading
-
-    def run_oracle(sql, timeout_s=90.0):
-        """SQLite with a deadline: some Spark-shaped plans (OR-heavy
-        cross joins) are quadratic under SQLite's optimizer; those queries
-        are skipped, not allowed to wedge the gate."""
-        timer = threading.Timer(timeout_s, con.interrupt)
-        timer.start()
-        try:
-            return con.execute(to_sqlite_sql(sql)).fetchall()
-        finally:
-            timer.cancel()
-
-    passed, failed, skipped = [], [], []
+    passed, failed, skipped, vacuous = [], [], [], []
     for q in want:
         if q in DIALECT_SKIPS:
             skipped.append((q, DIALECT_SKIPS[q]))
             print(f"SKIP {q:16s} dialect: {DIALECT_SKIPS[q][:80]}",
                   flush=True)
             continue
-        sql = queries[q]
+        ov = overrides.get(q, {})
+        q_scale = str(ov.get("scale", SCALE))
+        q_seed = int(ov.get("seed", default_seed))
+        con, session = ctx(q_scale)
+        sql = stream(q_scale, q_seed)[q]
+        tag = "" if (q_scale == SCALE and q_seed == default_seed) else \
+            f" [sf{q_scale} seed{q_seed}]"
         try:
-            oracle_rows = run_oracle(sql)
+            oracle_rows = execute_oracle(con, sql)
         except sqlite3.Error as e:
             skipped.append((q, f"sqlite: {e}"))
             print(f"SKIP {q:16s} sqlite: {str(e)[:90]}", flush=True)
@@ -290,13 +368,17 @@ def main():
         ok, why = rows_match(engine_rows, oracle_rows)
         if ok:
             passed.append(q)
-            print(f"PASS {q:16s} rows={len(engine_rows)}", flush=True)
+            if not engine_rows:
+                vacuous.append(q)
+            print(f"PASS {q:16s} rows={len(engine_rows)}{tag}", flush=True)
         else:
             failed.append((q, why))
-            print(f"FAIL {q:16s} {why[:100]}", flush=True)
+            print(f"FAIL {q:16s} {why[:100]}{tag}", flush=True)
 
     print(f"\n=== oracle parity: {len(passed)} passed, {len(failed)} failed, "
           f"{len(skipped)} skipped (sqlite dialect) ===")
+    if vacuous:
+        print(f"  vacuous (0-row) passes: {' '.join(vacuous)}")
     for q, why in failed:
         print(f"  FAIL {q}: {why[:140]}")
     return 1 if failed else 0

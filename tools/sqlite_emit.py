@@ -1,0 +1,497 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""AST -> SQLite SQL emitter for the independent oracle.
+
+The textual Spark->SQLite rewrites in oracle_validate.py cover most of the
+corpus but cannot express what SQLite lacks structurally: ROLLUP / GROUPING
+SETS (expanded here into a UNION ALL of per-level grouped selects, with
+window functions lifted OVER the union so ranks span levels, exactly like
+the SQL standard's evaluation order), grouping() flags (per-level 0/1
+literals), and stddev/var (two-pass closed form; sample forms go NULL at
+n<2 via SQLite's NULL division).
+
+Independence note: this reuses the framework's PARSER to read the query,
+but evaluation is entirely SQLite's — a planner/engine bug cannot cancel
+out. A parser bug that misreads a query would desync the two sides and
+show up as a parity FAILURE, not a silent pass (both engines would have to
+misread the same text the same way for a false pass, which is the shared
+risk any oracle harness that reads the same query text carries).
+
+Ref: /root/reference/nds/nds_validate.py:48-114 (the reference gates all 99
+queries against a second engine; this module closes the last 17 here).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from nds_tpu.sql import ast as A                     # noqa: E402
+from nds_tpu.sql.parser import AGG_FUNCS, parse      # noqa: E402
+
+
+class EmitError(ValueError):
+    pass
+
+
+_KEYWORDS = {"order", "group", "by", "select", "from", "where", "limit",
+             "having", "union", "case", "when", "then", "else", "end",
+             "join", "on", "desc", "asc", "as", "and", "or", "not", "in"}
+
+
+def _q(name: str) -> str:
+    """Quote an output name unless it is a plain, non-keyword identifier
+    (TPC-DS aliases include \"order count\" and \"30 days\")."""
+    if name.isidentifier() and name.lower() not in _KEYWORDS:
+        return name
+    return '"%s"' % name.replace('"', '""')
+
+
+def _str(v: str) -> str:
+    return "'" + v.replace("'", "''") + "'"
+
+
+def _gkey(e) -> str:
+    """Group-expression identity key, ignoring table qualifiers (rollup
+    select lists reference keys bare while GROUP BY may qualify them)."""
+    if isinstance(e, A.ColumnRef):
+        return f"col:{e.name}".lower()
+    from nds_tpu.sql.parser import expr_key
+    return expr_key(e)
+
+
+class Emitter:
+    def __init__(self, force_order: bool = False):
+        self.synth = 0
+        # emit comma-joined FROM lists as CROSS JOIN: SQLite treats that
+        # as a join-reorder barrier, pinning the template's textual order
+        # (fact first, indexed dimension lookups after) — the escape hatch
+        # for q64-class 19-relation joins where the greedy planner's own
+        # order never terminates
+        self.force_order = force_order
+
+    # ------------------------------------------------------------ queries
+
+    def query(self, q: A.Query) -> str:
+        parts = []
+        if q.ctes:
+            parts.append("with " + ", ".join(
+                f"{name} as ({self.query(cq)})" for name, cq in q.ctes))
+        parts.append(self.body(q.body))
+        if q.order_by:
+            parts.append("order by " + ", ".join(
+                self.order_item(e, d, nl) for e, d, nl in q.order_by))
+        if q.limit is not None:
+            parts.append(f"limit {int(q.limit)}")
+        return " ".join(parts)
+
+    def order_item(self, e, desc, nulls_last) -> str:
+        s = self.expr(e) + (" desc" if desc else " asc")
+        # engine default: nulls first on asc, last on desc (Spark); make it
+        # explicit — SQLite's own default happens to match but only for
+        # plain asc/desc
+        s += " nulls last" if nulls_last else " nulls first"
+        return s
+
+    def body(self, b) -> str:
+        if isinstance(b, A.Query):
+            return f"select * from ({self.query(b)})"
+        if isinstance(b, A.SetOp):
+            op = {"union": "union", "union_all": "union all",
+                  "intersect": "intersect", "except": "except"}[b.op]
+            return f"{self.body(b.left)} {op} {self.body(b.right)}"
+        if isinstance(b, A.Select):
+            return self.select(b)
+        raise EmitError(f"unsupported body {type(b).__name__}")
+
+    # ------------------------------------------------------------ selects
+
+    def select(self, s: A.Select) -> str:
+        if s.group_by is not None and s.group_by.kind != "plain":
+            return self.grouping_sets_select(s)
+        out = ["select"]
+        if s.distinct:
+            out.append("distinct")
+        out.append(", ".join(self.select_item(it) for it in s.items))
+        if s.from_ is not None:
+            out.append("from " + self.from_(s.from_))
+        if s.where is not None:
+            out.append("where " + self.expr(s.where))
+        if s.group_by is not None and s.group_by.exprs:
+            out.append("group by " + ", ".join(
+                self.expr(e) for e in s.group_by.exprs))
+        if s.having is not None:
+            out.append("having " + self.expr(s.having))
+        return " ".join(out)
+
+    def select_item(self, it: A.SelectItem) -> str:
+        if isinstance(it.expr, A.Star):
+            return (it.expr.table + ".*") if it.expr.table else "*"
+        s = self.expr(it.expr)
+        alias = it.alias
+        if alias is None and isinstance(it.expr, A.ColumnRef) and \
+                it.expr.table:
+            # make the output name an explicit alias: Spark resolves an
+            # unqualified ORDER BY against the output column, SQLite only
+            # against real aliases (q58's `order by item_id` over three
+            # tables that all expose item_id is otherwise "ambiguous")
+            alias = it.expr.name
+        if alias:
+            s += f" as {_q(alias)}"
+        return s
+
+    # ---------------------------------------------- rollup/grouping sets
+
+    def grouping_sets_select(self, s: A.Select) -> str:
+        """Expand rollup/cube/sets into UNION ALL of per-level grouped
+        selects. grouping(e) becomes a per-level literal; keys absent from
+        a level become NULL. Window functions must see the WHOLE rollup
+        result (rank spans levels), so they are lifted into an outer select
+        over the union, with every level-dependent subexpression (aggregate
+        call, grouping() flag, key column) replaced by a synthesized inner
+        alias."""
+        gb = s.group_by
+        keys = {_gkey(e) for e in gb.exprs}
+        has_window = any(self._contains_window(it.expr) for it in s.items)
+
+        if not has_window:
+            levels = [self._level_select(s, level) for level in gb.sets]
+            return " union all ".join(levels)
+
+        # windowed rollup: inner per-level selects emit plain items plus
+        # synthesized columns for every level-dependent node referenced
+        # inside a window; the outer select computes the windows over the
+        # concatenated levels.
+        inner_extra: list[A.SelectItem] = []     # synthesized inner items
+        synth_map: dict[str, str] = {}           # expr key -> synth alias
+
+        def lift(e):
+            """Rewrite a window-internal expr: level-dependent nodes become
+            refs to synthesized inner columns."""
+            if isinstance(e, A.FuncCall) and (
+                    e.name in AGG_FUNCS or e.name == "grouping"):
+                k = _gkey(e)
+                if k not in synth_map:
+                    alias = f"_w{len(synth_map)}"
+                    synth_map[k] = alias
+                    inner_extra.append(A.SelectItem(e, alias))
+                return A.ColumnRef(synth_map[k])
+            if isinstance(e, A.ColumnRef) and _gkey(e) in keys:
+                k = _gkey(e)
+                if k not in synth_map:
+                    alias = f"_w{len(synth_map)}"
+                    synth_map[k] = alias
+                    inner_extra.append(A.SelectItem(e, alias))
+                return A.ColumnRef(synth_map[k])
+            if isinstance(e, A.BinaryOp):
+                return A.BinaryOp(e.op, lift(e.left), lift(e.right))
+            if isinstance(e, A.UnaryOp):
+                return A.UnaryOp(e.op, lift(e.operand))
+            if isinstance(e, A.Case):
+                return A.Case(
+                    [(lift(c), lift(r)) for c, r in e.branches],
+                    None if e.else_ is None else lift(e.else_),
+                    None if e.operand is None else lift(e.operand))
+            if isinstance(e, A.Cast):
+                return A.Cast(lift(e.expr), e.target)
+            if isinstance(e, A.IsNull):
+                return A.IsNull(lift(e.expr), e.negated)
+            if isinstance(e, (A.Literal, A.DateLiteral)):
+                return e
+            if isinstance(e, A.FuncCall):
+                return A.FuncCall(e.name, [lift(a) for a in e.args],
+                                  e.distinct, e.star)
+            raise EmitError(
+                f"unsupported node under rollup window: {type(e).__name__}")
+
+        outer_items = []
+        for i, it in enumerate(s.items):
+            name = it.alias or (it.expr.name if isinstance(
+                it.expr, A.ColumnRef) else f"_c{i}")
+            if self._contains_window(it.expr):
+                if not isinstance(it.expr, A.WindowFunc):
+                    raise EmitError("window nested in expression "
+                                    "unsupported under rollup")
+                w = it.expr
+                lifted = A.WindowFunc(
+                    A.FuncCall(w.func.name, [lift(a) for a in w.func.args],
+                               w.func.distinct, w.func.star),
+                    A.WindowSpec([lift(p) for p in w.spec.partition_by],
+                                 [(lift(e), d, nl)
+                                  for e, d, nl in w.spec.order_by],
+                                 w.spec.frame))
+                outer_items.append(
+                    self.expr(lifted) + f" as {_q(name)}")
+            else:
+                # plain item: ensure the inner emits it under this name
+                outer_items.append(_q(name))
+        inner_items = [
+            A.SelectItem(it.expr,
+                         it.alias or (it.expr.name if isinstance(
+                             it.expr, A.ColumnRef) else f"_c{i}"))
+            for i, it in enumerate(s.items)
+            if not self._contains_window(it.expr)]
+        inner = A.Select(inner_items + inner_extra, s.from_, s.where,
+                         gb, s.having, s.distinct)
+        levels = [self._level_select(inner, level) for level in gb.sets]
+        union = " union all ".join(levels)
+        return f"select {', '.join(outer_items)} from ({union})"
+
+    def _level_select(self, s: A.Select, level: list) -> str:
+        """One grouping-set level as a plain grouped select: keys not in
+        the level project NULL, grouping(e) is a literal."""
+        level_keys = {_gkey(e) for e in level}
+        all_keys = {_gkey(e) for e in s.group_by.exprs}
+
+        def rewrite(e):
+            if isinstance(e, A.FuncCall):
+                if e.name == "grouping":
+                    return A.Literal(
+                        0 if _gkey(e.args[0]) in level_keys else 1)
+                if e.name in AGG_FUNCS:
+                    return e                 # aggregates see base rows
+                if _gkey(e) in all_keys:     # expression group key
+                    return e if _gkey(e) in level_keys else A.Literal(None)
+                return A.FuncCall(e.name, [rewrite(a) for a in e.args],
+                                  e.distinct, e.star)
+            if _gkey(e) in all_keys and _gkey(e) not in level_keys:
+                return A.Literal(None)
+            if isinstance(e, A.BinaryOp):
+                return A.BinaryOp(e.op, rewrite(e.left), rewrite(e.right))
+            if isinstance(e, A.UnaryOp):
+                return A.UnaryOp(e.op, rewrite(e.operand))
+            if isinstance(e, A.Case):
+                return A.Case(
+                    [(rewrite(c), rewrite(r)) for c, r in e.branches],
+                    None if e.else_ is None else rewrite(e.else_),
+                    None if e.operand is None else rewrite(e.operand))
+            if isinstance(e, A.Cast):
+                return A.Cast(rewrite(e.expr), e.target)
+            return e
+
+        items = [A.SelectItem(rewrite(it.expr), it.alias) for it in s.items]
+        having = None if s.having is None else rewrite(s.having)
+        lvl = A.Select(items, s.from_, s.where,
+                       A.GroupingSets("plain", [list(level)], list(level)),
+                       having, s.distinct)
+        return self.select(lvl)
+
+    def _contains_window(self, e) -> bool:
+        if isinstance(e, A.WindowFunc):
+            return True
+        if isinstance(e, A.BinaryOp):
+            return (self._contains_window(e.left)
+                    or self._contains_window(e.right))
+        if isinstance(e, A.UnaryOp):
+            return self._contains_window(e.operand)
+        if isinstance(e, A.Cast):
+            return self._contains_window(e.expr)
+        if isinstance(e, A.Case):
+            return any(self._contains_window(x)
+                       for c, r in e.branches for x in (c, r)) or (
+                e.else_ is not None and self._contains_window(e.else_))
+        if isinstance(e, A.FuncCall):
+            return any(self._contains_window(a) for a in e.args)
+        return False
+
+    # --------------------------------------------------------------- FROM
+
+    def from_(self, f) -> str:
+        if isinstance(f, list):
+            sep = " cross join " if self.force_order else ", "
+            return sep.join(self.from_(x) for x in f)
+        if isinstance(f, A.TableRef):
+            return f.name + (f" as {f.alias}" if f.alias else "")
+        if isinstance(f, A.SubqueryRef):
+            return f"({self.query(f.query)}) as {f.alias}"
+        if isinstance(f, A.Join):
+            kind = {"inner": "join", "left": "left join",
+                    "right": "right join", "full": "full join",
+                    "cross": "cross join"}[f.kind]
+            s = f"{self.from_(f.left)} {kind} {self.from_(f.right)}"
+            if f.condition is not None:
+                s += " on " + self.expr(f.condition)
+            return s
+        raise EmitError(f"unsupported FROM {type(f).__name__}")
+
+    # ---------------------------------------------------------- exprs
+
+    def expr(self, e) -> str:
+        if isinstance(e, A.Literal):
+            v = e.value
+            if v is None:
+                return "null"
+            if isinstance(v, bool):
+                return "1" if v else "0"
+            if isinstance(v, str):
+                return _str(v)
+            return str(v)
+        if isinstance(e, A.DateLiteral):
+            return _str(e.text)
+        if isinstance(e, A.ColumnRef):
+            return (_q(e.table) + "." if e.table else "") + _q(e.name)
+        if isinstance(e, A.Star):
+            return "*"
+        if isinstance(e, A.UnaryOp):
+            if e.op == "not":
+                return f"not ({self.expr(e.operand)})"
+            return f"{e.op}({self.expr(e.operand)})"
+        if isinstance(e, A.BinaryOp):
+            return self.binop(e)
+        if isinstance(e, A.Between):
+            neg = "not " if e.negated else ""
+            return (f"({self.expr(e.expr)} {neg}between "
+                    f"{self.expr(e.low)} and {self.expr(e.high)})")
+        if isinstance(e, A.InList):
+            neg = "not " if e.negated else ""
+            items = ", ".join(self.expr(x) for x in e.items)
+            return f"({self.expr(e.expr)} {neg}in ({items}))"
+        if isinstance(e, A.InSubquery):
+            neg = "not " if e.negated else ""
+            return (f"({self.expr(e.expr)} {neg}in "
+                    f"({self.query(e.query)}))")
+        if isinstance(e, A.Exists):
+            neg = "not " if e.negated else ""
+            return f"({neg}exists ({self.query(e.query)}))"
+        if isinstance(e, A.ScalarSubquery):
+            return f"({self.query(e.query)})"
+        if isinstance(e, A.Like):
+            neg = "not " if e.negated else ""
+            return f"({self.expr(e.expr)} {neg}like {_str(e.pattern)})"
+        if isinstance(e, A.IsNull):
+            neg = "not " if e.negated else ""
+            return f"({self.expr(e.expr)} is {neg}null)"
+        if isinstance(e, A.Case):
+            out = ["case"]
+            if e.operand is not None:
+                out.append(self.expr(e.operand))
+            for c, r in e.branches:
+                out.append(f"when {self.expr(c)} then {self.expr(r)}")
+            if e.else_ is not None:
+                out.append(f"else {self.expr(e.else_)}")
+            out.append("end")
+            return "(" + " ".join(out) + ")"
+        if isinstance(e, A.Cast):
+            return self.cast(e)
+        if isinstance(e, A.FuncCall):
+            return self.func(e)
+        if isinstance(e, A.WindowFunc):
+            return self.window(e)
+        if isinstance(e, A.QuantifiedCompare):
+            raise EmitError("ANY/ALL quantifier unsupported in SQLite")
+        raise EmitError(f"unsupported expr {type(e).__name__}")
+
+    def binop(self, e: A.BinaryOp) -> str:
+        # date +/- interval -> SQLite date() modifier (dates are ISO text)
+        if e.op in ("+", "-") and isinstance(e.right, A.IntervalLiteral):
+            unit = {"day": "days", "month": "months",
+                    "year": "years"}[e.right.unit]
+            sign = e.op if e.right.amount >= 0 else (
+                "-" if e.op == "+" else "+")
+            return (f"date({self.expr(e.left)}, "
+                    f"'{sign}{abs(e.right.amount)} {unit}')")
+        if isinstance(e.left, A.IntervalLiteral) or \
+                isinstance(e.right, A.IntervalLiteral):
+            raise EmitError("interval position unsupported")
+        op = e.op
+        if op == "<>":
+            op = "!="
+        if op == "/":
+            # Spark '/' is true division; SQLite integer '/' truncates.
+            # Multiplying one side by 1.0 forces REAL division always.
+            return f"(({self.expr(e.left)}) * 1.0 / ({self.expr(e.right)}))"
+        return f"({self.expr(e.left)} {op} {self.expr(e.right)})"
+
+    def cast(self, e: A.Cast) -> str:
+        t = e.target.lower()
+        if t == "date":
+            return f"date({self.expr(e.expr)})"
+        if t.startswith(("decimal", "double", "float")):
+            return f"cast({self.expr(e.expr)} as real)"
+        if t.startswith(("int", "bigint")):
+            return f"cast({self.expr(e.expr)} as integer)"
+        if t.startswith(("char", "varchar", "string")):
+            return f"cast({self.expr(e.expr)} as text)"
+        raise EmitError(f"unsupported cast target {e.target}")
+
+    def func(self, e: A.FuncCall) -> str:
+        name = e.name.lower()
+        if name in ("stddev_samp", "stddev", "var_samp", "variance"):
+            # two-pass closed form; n<2 -> x/0 -> NULL in SQLite, matching
+            # the sample definition's undefined-at-1 semantics
+            x = self.expr(e.args[0])
+            var = (f"((count({x})*sum(({x})*({x})) - sum({x})*sum({x})) "
+                   f"* 1.0 / (count({x}) * (count({x}) - 1.0)))")
+            if name.startswith("var"):
+                return var
+            # max(var, 0): the closed form can go epsilon-negative
+            return f"sqrt(max({var}, 0.0))"
+        if name == "grouping":
+            raise EmitError("grouping() outside rollup context")
+        if name == "concat":
+            return "(" + " || ".join(self.expr(a) for a in e.args) + ")"
+        if name == "substring":
+            name = "substr"
+        if e.star:
+            return f"{name}(*)"
+        inner = ", ".join(self.expr(a) for a in e.args)
+        if e.distinct:
+            inner = "distinct " + inner
+        return f"{name}({inner})"
+
+    def window(self, e: A.WindowFunc) -> str:
+        parts = []
+        if e.spec.partition_by:
+            parts.append("partition by " + ", ".join(
+                self.expr(p) for p in e.spec.partition_by))
+        if e.spec.order_by:
+            parts.append("order by " + ", ".join(
+                self.order_item(x, d, nl) for x, d, nl in e.spec.order_by))
+        if e.spec.frame == "rows_unbounded_preceding":
+            parts.append("rows between unbounded preceding and current row")
+        elif e.spec.frame == "range_unbounded_preceding":
+            parts.append("range between unbounded preceding and current row")
+        elif e.spec.frame is not None:
+            raise EmitError(f"unsupported frame {e.spec.frame}")
+        return f"{self.func(e.func)} over ({' '.join(parts)})"
+
+
+def to_sqlite(sql_text: str) -> str:
+    """Parse a Spark-dialect query with the framework parser and emit
+    faithful SQLite SQL (rollup expanded, stddev closed-form, intervals as
+    date() modifiers)."""
+    stmt = parse(sql_text)
+    if not isinstance(stmt, A.Query):
+        raise EmitError(f"not a query: {type(stmt).__name__}")
+    return Emitter().query(stmt)
+
+
+def to_sqlite_script(sql_text: str, force_order: bool = False) -> list[str]:
+    """Like :func:`to_sqlite` but materializes every CTE as an indexed
+    TEMP TABLE (dropped/recreated per query). SQLite re-evaluates a
+    WITH-clause body at every reference and joins it without indexes —
+    q64-class self-joined CTEs go quadratic-at-best; one materialization
+    plus a surrogate-key index restores the linear plan the engine (and
+    Spark) use. Returns an ordered statement list; the LAST statement is
+    the query whose rows are the result."""
+    stmt = parse(sql_text)
+    if not isinstance(stmt, A.Query):
+        raise EmitError(f"not a query: {type(stmt).__name__}")
+    em = Emitter(force_order=force_order)
+    stmts: list[str] = []
+    for name, cq in stmt.ctes:
+        stmts.append(f"drop table if exists {name}")
+        stmts.append(f"create temp table {name} as {em.query(cq)}")
+        # surrogate-key indexes on the materialized CTE keep SQLite's
+        # nested-loop joins out of quadratic territory (same policy as the
+        # base-table load); the harness resolves column names via PRAGMA
+        stmts.append(f"--index-sk:{name}")
+    body = A.Query(stmt.body, stmt.order_by, stmt.limit, [])
+    stmts.append(em.query(body))
+    return stmts
+
+
+if __name__ == "__main__":
+    print(to_sqlite(sys.stdin.read()))
