@@ -39,9 +39,25 @@ def enable_compile_cache() -> bool:
         if _os.environ.get("NDS_TPU_COMP_CACHE") != "force" and \
                 _jax.default_backend() == "cpu":
             return False
+        # CPU cache dirs are keyed by a machine fingerprint: XLA:CPU AOT
+        # artifacts bake the compile host's vector ISA, and loading one on
+        # a host without those features segfaults/SIGILLs mid-run (seen:
+        # a cross-machine cache killed a 103-query sweep at query 81)
+        suffix = ""
+        if _jax.default_backend() == "cpu":
+            import hashlib
+            import platform
+            try:
+                with open("/proc/cpuinfo") as f:
+                    flags = [ln for ln in f if ln.startswith("flags")][0]
+            except (OSError, IndexError):  # pragma: no cover - non-Linux
+                flags = platform.processor()
+            suffix = "_cpu_" + hashlib.sha1(
+                flags.encode()).hexdigest()[:12]
         _cache_dir = _os.environ.get(
             "NDS_TPU_COMP_CACHE_DIR",
-            _os.path.join(_os.path.expanduser("~"), ".cache", "nds_tpu_xla"))
+            _os.path.join(_os.path.expanduser("~"), ".cache",
+                          f"nds_tpu_xla{suffix}"))
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
         # eager table-at-a-time execution makes many small compilations, so

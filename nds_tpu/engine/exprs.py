@@ -341,17 +341,26 @@ def cast(col: Column, target: str) -> Column:
     if t in ("string", "varchar", "char") or t.startswith(("char(", "varchar(")):
         if col.kind == "str":
             return col
-        vals = np.asarray(col.data)
-        if is_dec(col.kind):
-            s = col.scale
-            strs = np.asarray([_dec_str(int(v), s) for v in vals], dtype=object)
-        elif col.kind == "date":
-            strs = np.asarray([_date_str(int(v)) for v in vals], dtype=object)
-        else:
-            strs = np.asarray([str(v) for v in vals], dtype=object)
-        uniq, inv = np.unique(strs, return_inverse=True)
-        return Column("str", jnp.asarray(inv.astype(np.int32)), col.valid,
-                      uniq.astype(object))
+
+        def fetch():
+            # host-side dictionary build from the column values — a whole-
+            # column fetch, so it routes through the trace-replay log
+            vals = np.asarray(col.data)
+            if is_dec(col.kind):
+                s = col.scale
+                strs = np.asarray([_dec_str(int(v), s) for v in vals],
+                                  dtype=object)
+            elif col.kind == "date":
+                strs = np.asarray([_date_str(int(v)) for v in vals],
+                                  dtype=object)
+            else:
+                strs = np.asarray([str(v) for v in vals], dtype=object)
+            uniq, inv = np.unique(strs, return_inverse=True)
+            return inv.astype(np.int32), uniq.astype(object)
+
+        from nds_tpu.engine.ops import host_read
+        inv, uniq = host_read("cast_str", fetch)
+        return Column("str", jnp.asarray(inv), col.valid, uniq)
     raise ValueError(f"unsupported cast target: {target}")
 
 
@@ -468,23 +477,28 @@ def fn_length(col: Column) -> Column:
 
 
 def fn_concat(cols) -> Column:
-    """String || concatenation; distinct combinations resolved on host."""
-    parts = []
-    for c in cols:
-        if c.kind != "str":
-            c = cast(c, "string")
-        parts.append(np.asarray(c.dict_values.astype(str))[np.asarray(c.data)])
-    combined = parts[0].astype(object)
-    for p in parts[1:]:
-        combined = combined + p.astype(object)
-    uniq, inv = np.unique(combined.astype(str), return_inverse=True)
+    """String || concatenation; distinct combinations resolved on host
+    (a whole-column fetch — routed through the trace-replay log)."""
+    cols = [c if c.kind == "str" else cast(c, "string") for c in cols]
+
+    def fetch():
+        parts = [np.asarray(c.dict_values.astype(str))[np.asarray(c.data)]
+                 for c in cols]
+        combined = parts[0].astype(object)
+        for p in parts[1:]:
+            combined = combined + p.astype(object)
+        uniq, inv = np.unique(combined.astype(str), return_inverse=True)
+        return inv.astype(np.int32), uniq.astype(object)
+
+    from nds_tpu.engine.ops import host_read
+    inv, uniq = host_read("concat", fetch)
     valid = None
     vs = [c.valid for c in cols if c.valid is not None]
     if vs:
         valid = vs[0]
         for v in vs[1:]:
             valid = valid & v
-    return Column("str", jnp.asarray(inv.astype(np.int32)), valid, uniq.astype(object))
+    return Column("str", jnp.asarray(inv), valid, uniq.astype(object))
 
 
 def like_to_regex(pattern: str) -> str:

@@ -262,10 +262,24 @@ def _segment_sum_exact_pallas(gids, values, num_segments: int,
     return sums, acc[k]
 
 
+# measured crossover on v5e (min-of-5, hard device->host sync; n x G):
+#   1M x 256:  pallas 60.5ms  vs XLA  83.6ms   (pallas 1.38x)
+#   4M x 1024: pallas 132.5ms vs XLA 102.2ms   (XLA 1.30x)
+#  16M x 1024: pallas 187.8ms vs XLA  97.4ms   (XLA 1.93x)
+#  16M x 2048: pallas 352.4ms vs XLA 107.5ms   (XLA 3.28x)
+# the one-hot matmul does O(n*G) MACs while XLA's scatter is O(n), so the
+# exact kernel engages only below the measured n*G break-even
+_EXACT_ONEHOT_BUDGET = int(float(os.environ.get(
+    "NDS_TPU_EXACT_ONEHOT_BUDGET", "3e8")))
+
+
 def exact_sum_supported(num_segments: int, n_rows: int) -> bool:
     """True when the exact limb-split kernel will engage: Pallas active
-    for this group count and per-limb i32 accumulation cannot overflow."""
-    return pallas_active(num_segments) and n_rows < (1 << 23)
+    for this group count, per-limb i32 accumulation cannot overflow, and
+    the O(n*G) one-hot work sits below the measured XLA-scatter
+    break-even (table above)."""
+    return (pallas_active(num_segments) and n_rows < (1 << 23)
+            and n_rows * max(num_segments, 1) <= _EXACT_ONEHOT_BUDGET)
 
 
 def segment_sum_exact(values, gids, num_segments: int):

@@ -96,13 +96,120 @@ def fetch_bytes() -> int:
     return getattr(_sync_tls, "fetch_bytes", 0)
 
 
+# --------------------------------------------------------------------------
+# trace-replay: every host read the engine performs routes through
+# host_read(), so a query can be RECORDED once (eager run, log of host
+# decisions) and then RE-TRACED under jax.jit with the log answering every
+# host read — compiling the entire query pipeline into ONE XLA program
+# (the Spark whole-stage-codegen analog; engine/replay.py drives this).
+# --------------------------------------------------------------------------
+
+
+class ReplayMismatch(RuntimeError):
+    """The replay trace consumed host reads in a different order than the
+    recording — the query is not replay-safe; callers fall back eager."""
+
+
+def replay_mode() -> str:
+    return getattr(_sync_tls, "replay_mode", "off")
+
+
+class _ReplaySession:
+    def __init__(self, mode: str, log, operands=None):
+        self.mode, self.log = mode, log
+        self.operands = operands
+
+    def __enter__(self):
+        self._prev = (replay_mode(), getattr(_sync_tls, "replay_log", None),
+                      getattr(_sync_tls, "replay_cursor", 0))
+        # snapshot ENTRIES (not an index): resolve_counts clears the list
+        # mid-trace, so positions shift — restoration must be by identity
+        self._pend_snapshot = list(_pending_counts())
+        self._prev_ops = getattr(_sync_tls, "replay_operands", None)
+        _sync_tls.replay_mode = self.mode
+        _sync_tls.replay_log = self.log
+        _sync_tls.replay_cursor = 0
+        _sync_tls.replay_operands = self.operands
+        return self.log
+
+    def __exit__(self, *exc):
+        if self.mode == "replay":
+            # counts created while TRACING hold tracer scalars; they must
+            # never reach a later eager device_get — keep only the entries
+            # that already existed when the trace began
+            lst = _pending_counts()
+            keep = [c for c in lst
+                    if any(c is s for s in self._pend_snapshot)]
+            lst[:] = keep
+        (_sync_tls.replay_mode, _sync_tls.replay_log,
+         _sync_tls.replay_cursor) = self._prev
+        _sync_tls.replay_operands = self._prev_ops
+
+
+def recording(log=None):
+    """Context: run eagerly while logging every host read."""
+    return _ReplaySession("record", [] if log is None else log)
+
+
+def replaying(log, operands=None):
+    """Context: serve every host read from ``log`` (device untouched);
+    ``operands`` resolves any lifted :class:`ArgRef` entries to traced
+    jit arguments."""
+    return _ReplaySession("replay", log, operands)
+
+
+class ArgRef:
+    """Placeholder in a replay log for a large array lifted into a jit
+    ARGUMENT (baking fact-sized host reads as jaxpr constants bloats the
+    compiled program; see replay.py). The replaying context resolves it to
+    the corresponding traced operand."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+
+def _resolve_refs(val):
+    ops = getattr(_sync_tls, "replay_operands", None)
+    if isinstance(val, ArgRef):
+        return ops[val.index]
+    if isinstance(val, tuple) and any(isinstance(x, ArgRef) for x in val):
+        return tuple(ops[x.index] if isinstance(x, ArgRef) else x
+                     for x in val)
+    return val
+
+
+def host_read(tag: str, fetch):
+    """The single host-read chokepoint. Off: just fetch. Record: fetch and
+    log. Replay: pop the recorded value — no device contact (large arrays
+    come back as traced jit operands via :class:`ArgRef`)."""
+    mode = replay_mode()
+    if mode == "replay":
+        log = _sync_tls.replay_log
+        i = _sync_tls.replay_cursor
+        if i >= len(log) or log[i][0] != tag:
+            got = log[i][0] if i < len(log) else "<end>"
+            raise ReplayMismatch(f"expected {got!r}, hit {tag!r} at {i}")
+        _sync_tls.replay_cursor = i + 1
+        return _resolve_refs(log[i][1])
+    val = fetch()
+    if mode == "record":
+        _sync_tls.replay_log.append((tag, val))
+    return val
+
+
 def host_sync(value) -> int:
     """Read a device scalar on host, counting the sync."""
-    add_syncs()
-    t0 = time.perf_counter_ns()
-    out = int(value)
-    add_sync_wait(time.perf_counter_ns() - t0)
-    return out
+
+    def fetch():
+        add_syncs()
+        t0 = time.perf_counter_ns()
+        out = int(value)
+        add_sync_wait(time.perf_counter_ns() - t0)
+        return out
+
+    return host_read("sync", fetch)
 
 
 class DeviceCount:
@@ -136,10 +243,14 @@ class DeviceCount:
             # not in the calling thread's pending list (created on another
             # stream's thread) or an earlier drain failed mid-transfer:
             # fetch directly rather than returning a poisoned None
-            add_syncs()
-            t0 = time.perf_counter_ns()
-            self._host = int(jax.device_get(self.dev))
-            add_sync_wait(time.perf_counter_ns() - t0)
+            def fetch():
+                add_syncs()
+                t0 = time.perf_counter_ns()
+                out = int(jax.device_get(self.dev))
+                add_sync_wait(time.perf_counter_ns() - t0)
+                return out
+
+            self._host = host_read("count1", fetch)
         return self._host
 
     def __repr__(self):
@@ -174,14 +285,19 @@ def resolve_counts() -> None:
     if not pend:
         lst.clear()
         return
-    t0 = time.perf_counter_ns()
-    # on a failed transfer (device preemption) the list survives untouched,
-    # so a retry drains it instead of stranding unresolved counts
-    vals = jax.device_get([c.dev for c in pend])
-    add_sync_wait(time.perf_counter_ns() - t0)
-    add_syncs()
+
+    def fetch():
+        t0 = time.perf_counter_ns()
+        # on a failed transfer (device preemption) the list survives
+        # untouched, so a retry drains it instead of stranding counts
+        vals = jax.device_get([c.dev for c in pend])
+        add_sync_wait(time.perf_counter_ns() - t0)
+        add_syncs()
+        return [int(v) for v in vals]
+
+    vals = host_read(f"counts{len(pend)}", fetch)
     for c, v in zip(pend, vals):
-        c._host = int(v)
+        c._host = v
     lst.clear()
 
 
@@ -323,7 +439,14 @@ def _identity_cache(cache: dict, max_size: int, key_arrays: tuple, compute,
     optional hashable ``static_key`` for non-array parameters the cached
     value depends on). The entry holds references to the keyed arrays so a
     recycled id() can never alias a freed object; evicts oldest-first past
-    ``max_size``."""
+    ``max_size``.
+
+    Under trace-replay the cache is BYPASSED: record and replay must
+    consume the same host-read sequence, and a record-time cache hit
+    (from an earlier query) would skip a read the replay trace performs
+    (tracer ids are always fresh)."""
+    if replay_mode() != "off":
+        return compute()
     key = (static_key,) + tuple(id(a) for a in key_arrays)
     hit = cache.get(key)
     if hit is not None and all(h is a for h, a in zip(hit[0], key_arrays)):
@@ -550,13 +673,16 @@ def _packed_group_plan(key_cols, views, n_valid):
         elif c.kind == "bool":
             spans[i] = (0, 1)
     if int_idx:
-        mins, maxs = _int_key_ranges(
-            tuple(views[i] for i in int_idx), n_valid)
-        add_syncs()
-        t0 = time.perf_counter_ns()
-        mins = np.asarray(mins)
-        maxs = np.asarray(maxs)
-        add_sync_wait(time.perf_counter_ns() - t0)
+        def fetch():
+            mins, maxs = _int_key_ranges(
+                tuple(views[i] for i in int_idx), n_valid)
+            add_syncs()
+            t0 = time.perf_counter_ns()
+            out = (np.asarray(mins), np.asarray(maxs))
+            add_sync_wait(time.perf_counter_ns() - t0)
+            return out
+
+        mins, maxs = host_read("group_ranges", fetch)
         for k, i in enumerate(int_idx):
             if mins[k] > maxs[k]:              # no live rows
                 spans[i] = (0, 0)
@@ -1129,17 +1255,26 @@ def _dense_dim_info(dim_key: Column, n_dim: int):
         return None
 
     def compute():
-        live = np.asarray(dim_key.data[:n_dim]).astype(np.int64)
-        if dim_key.valid is not None and \
-                not bool(np.all(np.asarray(dim_key.valid[:n_dim]))):
-            return None                       # null PKs: sort path handles
-        mn = int(live.min())
-        span = int(live.max()) - mn + 1
-        # sparse keys would blow the map; 4x slack covers SCD-style gaps
-        if span > max(4 * n_dim, 1 << 16) or span > (1 << 26):
+        def fetch():
+            live = np.asarray(dim_key.data[:n_dim]).astype(np.int64)
+            if dim_key.valid is not None and \
+                    not bool(np.all(np.asarray(dim_key.valid[:n_dim]))):
+                return None                   # null PKs: sort path handles
+            mn = int(live.min())
+            span = int(live.max()) - mn + 1
+            # sparse keys blow the map; 4x slack covers SCD-style gaps
+            if span > max(4 * n_dim, 1 << 16) or span > (1 << 26):
+                return None
+            pos = np.full(span, n_dim, dtype=np.int64)  # n_dim = miss mark
+            pos[live - mn] = np.arange(n_dim)
+            return mn, pos
+
+        # the host part (the fetched key array -> position map) routes
+        # through the replay log; only the device upload stays outside
+        got = host_read("dense_dim", fetch)
+        if got is None:
             return None
-        pos = np.full(span, n_dim, dtype=np.int64)   # n_dim = miss marker
-        pos[live - mn] = np.arange(n_dim)
+        mn, pos = got
         return mn, jnp.asarray(pos)
 
     # n_dim in the key: the position map's miss marker and coverage are
@@ -1242,12 +1377,16 @@ def pk_gather_join_multi(fact_keys, dim_keys, n_fact: int, n_dim: int,
         n_dim = n_dim.to_int()
 
     def compute():
-        mins, maxs = _int_key_ranges(
-            tuple(c.data for c in dim_keys), n_dim)
-        add_syncs()
-        t0 = time.perf_counter_ns()
-        mins, maxs = np.asarray(mins), np.asarray(maxs)
-        add_sync_wait(time.perf_counter_ns() - t0)
+        def fetch():
+            mins, maxs = _int_key_ranges(
+                tuple(c.data for c in dim_keys), n_dim)
+            add_syncs()
+            t0 = time.perf_counter_ns()
+            out = (np.asarray(mins), np.asarray(maxs))
+            add_sync_wait(time.perf_counter_ns() - t0)
+            return out
+
+        mins, maxs = host_read("dim_ranges", fetch)
         offsets, widths, spans, total = [], [], [], 0
         for lo, hi in zip(mins, maxs):
             span = max(int(hi) - int(lo), 0)
@@ -1332,9 +1471,13 @@ def _chunked_inner_join(left, right, left_keys, right_keys, probe,
     ``_PAIR_BUDGET`` pairs, with residual predicates applied per span
     before anything is kept — the pair expansion never exists whole."""
     counts, lo, order, total = probe
-    counts_np = np.asarray(counts)
-    spans = _chunk_spans(counts_np, _PAIR_BUDGET)
-    cum = np.concatenate([[0], np.cumsum(counts_np)])
+
+    def fetch():
+        counts_np = np.asarray(counts)
+        return (_chunk_spans(counts_np, _PAIR_BUDGET),
+                np.concatenate([[0], np.cumsum(counts_np)]))
+
+    spans, cum = host_read("chunk_spans", fetch)
     parts, schema_chunk = [], None
     for (s, e) in spans:
         span_total = int(cum[e] - cum[s])

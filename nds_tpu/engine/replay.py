@@ -1,0 +1,235 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Whole-query trace-replay compilation: ONE XLA program per query.
+
+The engine executes eagerly, table-at-a-time; on a remote-attached chip
+every one of the ~100-400 small dispatches a query makes pays tunnel
+latency, which dominates wall time even after the lazy-count work cut the
+BLOCKING reads to 1-3 per query (PERF.md: syncWait is still 80%+ of wall
+on tunneled SF0.05). The reference never has this problem: Spark compiles
+each stage to one JVM loop and the driver makes one round trip
+(ref: nds/nds_power.py:125-135).
+
+The TPU-native answer is the same one jit gives training loops: TRACE the
+whole query into one program and REPLAY it. Mechanics:
+
+1. RECORD: run the query eagerly once under ``ops.recording()`` — every
+   host read (bucket-sizing syncs, batched count resolutions, host-built
+   dimension maps, chunk span plans) logs its value in order.
+2. COMPILE: re-run the SAME planner code under ``jax.jit`` with the
+   session's catalog columns as arguments and ``ops.replaying(log)``
+   serving every host read from the recording — no device contact during
+   tracing. The result is one fused XLA program for the entire pipeline:
+   scans, joins, aggregation, sort, limit.
+3. REPLAY: subsequent executions of the same query text on the same data
+   version call the compiled program: one dispatch, one result fetch —
+   the reference's one-round-trip execution contract, plus XLA now
+   fuses/optimizes ACROSS operator boundaries the eager path could not.
+
+Safety: the replay cache is keyed on (query text, session data version);
+any catalog mutation bumps the version. A divergence between trace and
+recording raises ``ops.ReplayMismatch`` and the query permanently falls
+back to the eager path. Streaming (>HBM ChunkedTable) scans never enter
+the cache — their chunk loop is host-driven by design.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as _replace
+
+import jax
+
+from nds_tpu.engine import ops as E
+from nds_tpu.engine.table import DeviceTable
+
+
+class _NotReplayable(Exception):
+    pass
+
+
+import os as _os
+
+_MAX_EQNS = int(_os.environ.get("NDS_TPU_REPLAY_MAX_EQNS", "4500"))
+
+
+def _count_eqns(jaxpr) -> int:
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)     # unwrap ClosedJaxpr
+    n = 0
+    for eq in jaxpr.eqns:
+        n += 1
+        for v in eq.params.values():
+            if hasattr(v, "jaxpr"):
+                n += _count_eqns(v.jaxpr)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if hasattr(x, "jaxpr"):
+                        n += _count_eqns(x.jaxpr)
+    return n
+
+
+# log entries whose array payloads are DEVICE OPERANDS (consumed via
+# jnp.asarray and elementwise math only): these lift into jit arguments
+# instead of baking fact-sized constants into the program. Entries whose
+# values drive HOST decisions (sync counts, chunk spans, key ranges) must
+# stay literal. Maps tag -> indices of liftable tuple elements (None =
+# the whole value is one array).
+_LIFTABLE = {
+    "cast_str": (0,),          # (inv codes, dictionary)
+    "concat": (0,),
+    "date_part": None,
+    "month_arith": None,
+    "dense_dim": (1,),         # (base, position map) — may be None
+}
+_LIFT_MIN_ELEMS = 1024
+
+
+def _lift_log(log):
+    """Split a recorded log into (log-with-ArgRefs, operand arrays)."""
+    import numpy as np
+    out_log, operands = [], []
+
+    def lift(arr):
+        operands.append(arr)
+        return E.ArgRef(len(operands) - 1)
+
+    for tag, val in log:
+        idxs = _LIFTABLE.get(tag, ())
+        if idxs is None and isinstance(val, np.ndarray) and \
+                val.size >= _LIFT_MIN_ELEMS:
+            val = lift(val)
+        elif idxs and isinstance(val, tuple):
+            val = tuple(
+                lift(x) if (i in idxs and isinstance(x, np.ndarray)
+                            and x.size >= _LIFT_MIN_ELEMS) else x
+                for i, x in enumerate(val))
+        out_log.append((tag, val))
+    return out_log, operands
+
+
+class CompiledQuery:
+    """One compiled whole-query program + the metadata to call it."""
+
+    def __init__(self, session, stmt, log, out_template):
+        self.session = session
+        self.stmt = stmt
+        # big array payloads become jit ARGUMENTS (program stays small and
+        # the executable is not re-specialized to them)
+        self.log, self.operands = _lift_log(list(log))
+        # (names, kinds, dict_values, valids-present, plen, nrows_bound)
+        self.out_template = out_template
+        self.arg_spec = None       # [(table, col, has_valid)]
+        self.jitted = None
+
+    # ---------------------------------------------------------------- build
+
+    def _flat_args(self):
+        """The session catalog's column buffers, in a deterministic order
+        (re-collected at every call so maintenance-refreshed tables feed
+        the current buffers — the data version guards semantic change)."""
+        args = []
+        for tname, cname, has_valid in self.arg_spec:
+            col = self.session.catalog[tname][cname]
+            args.append(col.data)
+            if has_valid:
+                args.append(col.valid)
+        return args
+
+    def compile(self):
+        from nds_tpu.sql.planner import Planner
+        catalog = self.session.catalog
+        # lazy view counts resolve up front: a DeviceCount closed over the
+        # trace would leak a stale device scalar into the program
+        for t in catalog.values():
+            if isinstance(t, DeviceTable) and \
+                    isinstance(t.nrows, E.DeviceCount):
+                t.nrows = t.nrows.to_int()
+        # argument universe: every device table in the catalog (chunked
+        # tables disqualified the query before we get here)
+        self.arg_spec = []
+        for tname in sorted(catalog):
+            t = catalog[tname]
+            if not isinstance(t, DeviceTable):
+                raise _NotReplayable(f"{tname} is not device-resident")
+            for cname, col in t.columns.items():
+                self.arg_spec.append((tname, cname, col.valid is not None))
+        spec = self.arg_spec
+        base_tables = set(self.session.base_tables)
+        stmt, log = self.stmt, self.log
+        names, kinds, dicts, valided, plen, bound = self.out_template
+
+        def traced(flat, operands):
+            # rebuild the catalog around the traced buffers
+            cat = {}
+            i = 0
+            for tname, cname, has_valid in spec:
+                data = flat[i]
+                i += 1
+                valid = None
+                if has_valid:
+                    valid = flat[i]
+                    i += 1
+                src = catalog[tname][cname]
+                cat.setdefault(tname, {})[cname] = _replace(
+                    src, data=data, valid=valid)
+            cat2 = {t: DeviceTable(cols, catalog[t].nrows)
+                    for t, cols in cat.items()}
+            planner = Planner(cat2, base_tables=base_tables)
+            with E.replaying(log, operands):
+                out = planner.query(stmt)
+            outs = []
+            for n in names:
+                c = out[n]
+                outs.append(c.data)
+                outs.append(c.valid)
+            outs.append(E.count_arr(out.nrows))
+            return tuple(outs)
+
+        # validate the replay log end-to-end with the SAME trace the jit
+        # cache will reuse, and gate on program size: a handful of
+        # rollup+window giants (q67-class) trip superlinear XLA
+        # optimization time; they stay on the eager path rather than
+        # stall a compile queue
+        E.resolve_counts()   # the trace must start with a clean batch
+        self.jitted = jax.jit(traced)
+        try:
+            jaxpr = self.jitted.trace(
+                self._flat_args(), self.operands).jaxpr
+        except AttributeError:  # pragma: no cover - older jax
+            jaxpr = jax.make_jaxpr(traced)(
+                self._flat_args(), self.operands).jaxpr
+        n_eqns = _count_eqns(jaxpr)
+        if n_eqns > _MAX_EQNS:
+            self.jitted = None
+            raise _NotReplayable(
+                f"program too large to fuse profitably: {n_eqns} eqns")
+        return self
+
+    # ----------------------------------------------------------------- run
+
+    def run(self) -> DeviceTable:
+        from nds_tpu.engine.column import Column
+        names, kinds, dicts, valided, plen, bound = self.out_template
+        # the first call traces: stray real counts must not sit in the
+        # pending list where the traced resolve would batch them
+        E.resolve_counts()
+        outs = self.jitted(self._flat_args(), self.operands)
+        cols = {}
+        for j, n in enumerate(names):
+            data, valid = outs[2 * j], outs[2 * j + 1]
+            cols[n] = Column(kinds[j], data, valid, dicts[j])
+        nrows = E.DeviceCount(outs[-1], bound)
+        return DeviceTable(cols, nrows, plen=plen)
+
+
+def out_template_of(table: DeviceTable):
+    names = list(table.column_names)
+    kinds = [table[n].kind for n in names]
+    dicts = [table[n].dict_values for n in names]
+    valided = [table[n].valid is not None for n in names]
+    return (names, kinds, dicts, valided, table.plen,
+            E.count_bound(table.nrows))
+
+
+def record_eligible(session) -> bool:
+    """Only fully device-resident catalogs replay (a ChunkedTable's chunk
+    loop is host-driven)."""
+    return all(isinstance(t, DeviceTable) for t in session.catalog.values())

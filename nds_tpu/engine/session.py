@@ -9,6 +9,7 @@ Maintenance) routes through the snapshot warehouse when one is attached.
 
 from __future__ import annotations
 
+import os
 import time
 
 import pyarrow as pa
@@ -74,6 +75,14 @@ class Session:
         # shuffle (SURVEY.md §2.4.1, §5.8). Bucketed physical lengths are
         # powers of two >= 16, so any such mesh divides them evenly.
         self.mesh = None
+        # whole-query trace-replay compilation (engine/replay.py): keyed
+        # on (query text, data version). Default ON for accelerator
+        # backends (where per-dispatch tunnel/launch latency dominates);
+        # CPU opts in with NDS_TPU_REPLAY=force, everything off with =off.
+        self._data_version = 0
+        self._replay_cache: dict = {}
+        self._replay_seen: set = set()
+        self._replay_blacklist: set = set()
         shape = int(self.conf.get("mesh_shape") or
                     os.environ.get("NDS_MESH_SHAPE", "1"))
         if shape > 1:
@@ -150,6 +159,13 @@ class Session:
             self.base_tables.add(key)
         else:
             self.base_tables.discard(key)
+        # invalidate compiled replays: keys embed the version, so nothing
+        # compiled before this mutation can ever hit again — clear all
+        # three (the blacklist re-derives per data version)
+        self._data_version += 1
+        self._replay_cache.clear()
+        self._replay_seen.clear()
+        self._replay_blacklist.clear()
 
     def read_raw_view(self, name: str, path: str, fields) -> float:
         """Register a raw '|'-delimited table; returns elapsed seconds (the
@@ -187,6 +203,58 @@ class Session:
 
     # -- SQL ----------------------------------------------------------------
 
+    def _replay_on(self) -> bool:
+        env = os.environ.get("NDS_TPU_REPLAY", "auto")
+        if env == "off" or self.conf.get("replay") == "off":
+            return False
+        if env == "force":
+            return True
+        import jax
+        try:
+            return jax.default_backend() != "cpu"
+        except RuntimeError:  # pragma: no cover
+            return False
+
+    def _sql_replay(self, text: str, stmt, planner) -> Result:
+        """Trace-replay execution tiers (engine/replay.py): 1st sight of a
+        query runs eagerly; 2nd records host decisions and compiles the
+        whole pipeline into one XLA program; 3rd+ is one dispatch."""
+        from nds_tpu.engine import ops as E
+        from nds_tpu.engine import replay as R
+        key = (text, self._data_version)
+        hit = self._replay_cache.get(key)
+        if hit is not None:
+            try:
+                out = hit.run()
+                self.last_scanned = dict(hit.scan_bytes)
+                return Result(out)
+            except E.ReplayMismatch:
+                # structural divergence: permanently unreplayable
+                self._replay_cache.pop(key, None)
+                self._replay_blacklist.add(key)
+            except Exception as exc:
+                # transient runtime failure (device preemption, transfer
+                # error): surface it, keep the compiled program, fall back
+                # eager for THIS execution only
+                from nds_tpu.listener import report_task_failure
+                report_task_failure(
+                    "replayed query dispatch (one-off eager fallback)", exc)
+        if key in self._replay_seen and key not in self._replay_blacklist \
+                and R.record_eligible(self):
+            E.resolve_counts()   # stray pending counts must not enter the log
+            with E.recording() as log:
+                table = planner.query(stmt)
+            try:
+                cq = R.CompiledQuery(self, stmt, log,
+                                     R.out_template_of(table)).compile()
+                cq.scan_bytes = dict(planner.scanned)
+                self._replay_cache[key] = cq
+            except Exception:
+                self._replay_blacklist.add(key)
+            return Result(table)
+        self._replay_seen.add(key)
+        return Result(planner.query(stmt))
+
     def sql(self, text: str) -> Result:
         stmt = parse(text)
         planner = Planner(self.catalog, base_tables=self.base_tables)
@@ -194,6 +262,8 @@ class Session:
         # binds (read by the Power Run's per-query summaries)
         self.last_scanned = planner.scanned
         if isinstance(stmt, A.Query):
+            if self._replay_on():
+                return self._sql_replay(text, stmt, planner)
             return Result(planner.query(stmt))
         if isinstance(stmt, A.CreateTempView):
             # route through create_temp_view so a meshed session re-shards

@@ -1530,7 +1530,7 @@ class Planner:
     def _mask_ctx(self, ctx: EvalCtx, mask) -> EvalCtx:
         """Compact an aggregation context by a boolean mask (HAVING)."""
         m = mask & E.live_mask(ctx.table.plen, ctx.table.nrows)
-        n = int(jnp.sum(m))
+        n = E.host_sync(jnp.sum(m))    # counted + replay-logged
         idx = E.compact_indices(m, n)
         new = EvalCtx(DeviceTable(
             {nm: c.take(idx) for nm, c in ctx.table.columns.items()}, n,
@@ -1802,19 +1802,26 @@ class Planner:
             base = X.cast(base, "date")
         if iv.unit == "day":
             return Column("date", (base.data + amt).astype(base.data.dtype), base.valid)
-        # month/year arithmetic via numpy calendar math on host
-        days = np.asarray(base.data)
-        months = amt * (12 if iv.unit == "year" else 1)
-        d64 = _EPOCH64 + days.astype("timedelta64[D]")
-        m = d64.astype("datetime64[M]")
-        dom = (d64 - m.astype("datetime64[D]")).astype(int)
-        shifted_m = m + np.timedelta64(months, "M")
-        next_m = shifted_m + np.timedelta64(1, "M")
-        last_dom = ((next_m.astype("datetime64[D]") - np.timedelta64(1, "D"))
-                    - shifted_m.astype("datetime64[D]")).astype(int)
-        new_dom = np.minimum(dom, last_dom)
-        out = (shifted_m.astype("datetime64[D]") - _EPOCH64).astype(int) + new_dom
-        return Column("date", jnp.asarray(out.astype(np.int32)), base.valid)
+        # month/year arithmetic via numpy calendar math on host (a whole-
+        # column fetch — routed through the trace-replay log)
+        def fetch():
+            days = np.asarray(base.data)
+            months = amt * (12 if iv.unit == "year" else 1)
+            d64 = _EPOCH64 + days.astype("timedelta64[D]")
+            m = d64.astype("datetime64[M]")
+            dom = (d64 - m.astype("datetime64[D]")).astype(int)
+            shifted_m = m + np.timedelta64(months, "M")
+            next_m = shifted_m + np.timedelta64(1, "M")
+            last_dom = ((next_m.astype("datetime64[D]")
+                         - np.timedelta64(1, "D"))
+                        - shifted_m.astype("datetime64[D]")).astype(int)
+            new_dom = np.minimum(dom, last_dom)
+            out = (shifted_m.astype("datetime64[D]")
+                   - _EPOCH64).astype(int) + new_dom
+            return out.astype(np.int32)
+
+        out = E.host_read("month_arith", fetch)
+        return Column("date", jnp.asarray(out), base.valid)
 
     def _eval_in_list(self, e: A.InList, ctx: EvalCtx) -> Column:
         col = self.eval_expr(e.expr, ctx)
@@ -1925,21 +1932,26 @@ class Planner:
         raise ExecError(f"unsupported function {name}")
 
     def _date_part(self, col: Column, part: str) -> Column:
-        days = np.asarray(col.data)
-        d64 = _EPOCH64 + days.astype("timedelta64[D]")
-        y = d64.astype("datetime64[Y]").astype(int) + 1970
-        if part == "year":
-            out = y
-        else:
-            m_idx = d64.astype("datetime64[M]").astype(int)
-            month = m_idx % 12 + 1
-            if part == "month":
-                out = month
+        def fetch():
+            # host calendar math on the whole column — replay-logged
+            days = np.asarray(col.data)
+            d64 = _EPOCH64 + days.astype("timedelta64[D]")
+            y = d64.astype("datetime64[Y]").astype(int) + 1970
+            if part == "year":
+                out = y
             else:
-                dom = (d64 - d64.astype("datetime64[M]").astype("datetime64[D]")
-                       ).astype(int) + 1
-                out = dom
-        return Column("i64", jnp.asarray(out.astype(np.int64)), col.valid)
+                m_idx = d64.astype("datetime64[M]").astype(int)
+                month = m_idx % 12 + 1
+                if part == "month":
+                    out = month
+                else:
+                    dom = (d64 - d64.astype("datetime64[M]")
+                           .astype("datetime64[D]")).astype(int) + 1
+                    out = dom
+            return out.astype(np.int64)
+
+        return Column("i64", jnp.asarray(E.host_read("date_part", fetch)),
+                      col.valid)
 
     def _const_int(self, e) -> int:
         if isinstance(e, A.Literal) and isinstance(e.value, int):

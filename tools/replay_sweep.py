@@ -1,0 +1,84 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Replay-parity sweep: every query runs eager, then recorded, then through
+the compiled whole-query program — all three row sets must match. The
+trace-replay analog of the mesh-parity sweep (tools/coverage_sweep.py)."""
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["NDS_TPU_REPLAY"] = "force"
+os.environ.setdefault("NDS_TPU_COMP_CACHE", "force")
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+os.environ.setdefault("NDS_SWEEP_SCALE", "0.01")
+from tools.coverage_sweep import ensure_data  # noqa: E402
+from nds_tpu.power import gen_sql_from_stream  # noqa: E402
+from nds_tpu.engine.session import Session  # noqa: E402
+from nds_tpu.schema import get_schemas  # noqa: E402
+
+data_dir = ensure_data()
+queries = gen_sql_from_stream(
+    os.path.join(REPO, ".bench_cache", "sweep_stream", "query_0.sql"))
+if len(sys.argv) > 1:
+    queries = {k: v for k, v in queries.items()
+               if k in sys.argv[1].split(",")}
+session = Session()
+for tname, fields in get_schemas(use_decimal=True).items():
+    p = os.path.join(data_dir, f"{tname}.dat")
+    if os.path.exists(p):
+        session.read_raw_view(tname, p, fields)
+
+from nds_validate import compare  # noqa: E402
+
+
+def rows_eq(a, b):
+    """Order-insensitive with the validation driver's float epsilon: the
+    fused whole-query program may reassociate f64 reductions, shifting
+    last-ulp rounding exactly like the reference's CPU-vs-GPU plans do
+    (ref: nds/nds_validate.py epsilon rationale)."""
+    if len(a) != len(b):
+        return False
+    key = lambda r: tuple((x is None, round(x, 3) if isinstance(x, float)
+                           else str(x)) for x in r)
+    for ra, rb in zip(sorted(a, key=key), sorted(b, key=key)):
+        if len(ra) != len(rb):
+            return False
+        for x, y in zip(ra, rb):
+            if not compare(x, y, 1e-9):
+                return False
+    return True
+
+
+n_pass, n_fail, n_nocompile = 0, 0, []
+for q, sql in queries.items():
+    t0 = time.perf_counter()
+    try:
+        r1 = session.sql(sql).collect()       # eager
+        r2 = session.sql(sql).collect()       # record + compile
+        compiled = any(k[0] == sql for k in session._replay_cache)
+        r3 = session.sql(sql).collect()       # replayed
+        if not compiled:
+            n_nocompile.append(q)
+        if rows_eq(r1, r2) and rows_eq(r1, r3):
+            n_pass += 1
+            ms = (time.perf_counter() - t0) * 1000
+            print(f"PASS {q:16s} rows={len(r1)} "
+                  f"{'replayed' if compiled else 'EAGER-FALLBACK'} "
+                  f"{ms:7.0f}ms", flush=True)
+        else:
+            n_fail += 1
+            print(f"FAIL {q:16s} replay rows diverge "
+                  f"({len(r1)}/{len(r2)}/{len(r3)})", flush=True)
+    except Exception as e:
+        n_fail += 1
+        print(f"FAIL {q:16s} {type(e).__name__}: {str(e)[:90]}", flush=True)
+
+print(f"\n=== replay parity: {n_pass} passed, {n_fail} failed; "
+      f"{len(n_nocompile)} fell back eager ===")
+if n_nocompile:
+    print("fallbacks:", " ".join(n_nocompile))
+sys.exit(1 if n_fail else 0)
