@@ -205,13 +205,16 @@ class CompiledQuery:
 
     # ----------------------------------------------------------------- run
 
-    def run(self) -> DeviceTable:
+    def run(self, block: bool = False) -> DeviceTable:
         from nds_tpu.engine.column import Column
         names, kinds, dicts, valided, plen, bound = self.out_template
         # the first call traces: stray real counts must not sit in the
         # pending list where the traced resolve would batch them
         E.resolve_counts()
         outs = self.jitted(self._flat_args(), self.operands)
+        if block:
+            import jax as _jax
+            _jax.block_until_ready(outs[-1])
         cols = {}
         for j, n in enumerate(names):
             data, valid = outs[2 * j], outs[2 * j + 1]

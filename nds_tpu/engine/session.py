@@ -204,16 +204,17 @@ class Session:
     # -- SQL ----------------------------------------------------------------
 
     def _replay_on(self) -> bool:
-        env = os.environ.get("NDS_TPU_REPLAY", "auto")
-        if env == "off" or self.conf.get("replay") == "off":
-            return False
-        if env == "force":
-            return True
-        import jax
-        try:
-            return jax.default_backend() != "cpu"
-        except RuntimeError:  # pragma: no cover
-            return False
+        # OPT-IN (measured decision, round 3): on a REMOTE-attached chip
+        # the per-call round trip (~0.5-1s through the tunnel) floors a
+        # replayed query at ~1 RTT, and the giant fused programs schedule
+        # worse than the pipelined eager stream for about half the corpus
+        # — eager-with-lazy-counts measured faster end to end (1.09s vs
+        # 1.9-2.2s geomean). On a LOCALLY attached device the same replay
+        # path runs a query in ~20ms vs ~200ms eager (CPU measurement),
+        # so deployments with local chips should set NDS_TPU_REPLAY=on.
+        env = os.environ.get("NDS_TPU_REPLAY",
+                             str(self.conf.get("replay", "off")))
+        return env.lower() in ("on", "force", "1", "true")
 
     def _sql_replay(self, text: str, stmt, planner) -> Result:
         """Trace-replay execution tiers (engine/replay.py): 1st sight of a
@@ -221,11 +222,30 @@ class Session:
         whole pipeline into one XLA program; 3rd+ is one dispatch."""
         from nds_tpu.engine import ops as E
         from nds_tpu.engine import replay as R
+        import time as _time
         key = (text, self._data_version)
         hit = self._replay_cache.get(key)
         if hit is not None:
             try:
-                out = hit.run()
+                t0 = _time.perf_counter()
+                out = hit.run(block=True)
+                replay_s = _time.perf_counter() - t0
+                # SELF-TUNING: a giant fused program is not always faster
+                # than the pipelined eager stream (measured both ways on
+                # the tunneled chip). Compare against the recorded eager
+                # wall (both sides block-to-completion); two consecutive
+                # slower runs evict the program and the query stays eager
+                # for this data version. The FIRST hit pays the one-time
+                # XLA compile and is excluded from strike accounting.
+                if hit.first_run:
+                    hit.first_run = False
+                elif replay_s > hit.eager_s * 1.1:
+                    hit.strikes += 1
+                    if hit.strikes >= 2:
+                        self._replay_cache.pop(key, None)
+                        self._replay_blacklist.add(key)
+                else:
+                    hit.strikes = 0
                 self.last_scanned = dict(hit.scan_bytes)
                 return Result(out)
             except E.ReplayMismatch:
@@ -242,12 +262,24 @@ class Session:
         if key in self._replay_seen and key not in self._replay_blacklist \
                 and R.record_eligible(self):
             E.resolve_counts()   # stray pending counts must not enter the log
+            t0 = _time.perf_counter()
             with E.recording() as log:
                 table = planner.query(stmt)
+            # block to completion so eager_s is a true wall, comparable to
+            # the blocked replay wall (async dispatch would otherwise
+            # under-count the eager side and mis-tune the eviction)
+            import jax as _jax
+            if table.columns:
+                _jax.block_until_ready(
+                    next(iter(table.columns.values())).data)
+            eager_s = _time.perf_counter() - t0
             try:
                 cq = R.CompiledQuery(self, stmt, log,
                                      R.out_template_of(table)).compile()
                 cq.scan_bytes = dict(planner.scanned)
+                cq.eager_s = eager_s
+                cq.strikes = 0
+                cq.first_run = True
                 self._replay_cache[key] = cq
             except Exception:
                 self._replay_blacklist.add(key)
