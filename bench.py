@@ -24,9 +24,10 @@ maximizes measured coverage, and the final JSON line is also emitted from a
 SIGTERM/SIGINT handler so an external `timeout` kill still yields a parsed
 result for whatever was measured.
 
-``vs_baseline`` compares against this framework's own first recorded value
-for the same query-set size (``.bench_baseline.json``); the reference
-publishes no absolute numbers (BASELINE.md).
+``vs_baseline`` compares against this framework's own first recorded
+per-query times in the COMMITTED ``BASELINE_TIMES.json`` (cross-round
+lineage, recomputable from git alone); the reference publishes no absolute
+numbers (BASELINE.md).
 """
 
 import argparse
@@ -200,13 +201,24 @@ def resolve_baseline(baseline_file, times, n_total):
     seeds, and an OOM-bound outlier joins whenever it first succeeds) but
     never overwrites an existing entry — the comparison stays longitudinal
     against the first measurement. vs_baseline is the geomean ratio over
-    the common query set."""
+    the common query set.
+
+    The baseline is a COMMITTED file (BASELINE_TIMES.json): losing it
+    would silently restart the lineage and make vs_baseline compare a
+    round against itself (this happened in round 3 when the scratch copy
+    was reseeded). A missing file is therefore an explicit, loud event."""
     base = None
     if os.path.exists(baseline_file):
         try:
             base = json.load(open(baseline_file))
         except ValueError:
             base = None
+    if base is None and not os.environ.get("NDS_BENCH_SEED_BASELINE"):
+        print(f"# {os.path.basename(baseline_file)} missing or unreadable: "
+              "REFUSING to start a new baseline lineage (restore it from "
+              "git, or set NDS_BENCH_SEED_BASELINE=1 to seed one on "
+              "purpose); vs_baseline reported as 0.0", file=sys.stderr)
+        return 0.0
     base_times = (base or {}).get("times") or {}
     common = sorted(set(times) & set(base_times))
     vs = (_geomean([base_times[q] for q in common]) /
@@ -215,10 +227,12 @@ def resolve_baseline(baseline_file, times, n_total):
     for q, t in times.items():
         merged.setdefault(q, t)
     if merged != base_times:
-        json.dump({"metric": "power_geomean_ms",
-                   "value": _geomean(list(merged.values())),
-                   "n_queries": len(merged), "times": merged},
-                  open(baseline_file, "w"))
+        out = {"metric": "power_geomean_ms",
+               "value": _geomean(list(merged.values())),
+               "n_queries": len(merged), "times": merged}
+        if isinstance(base, dict) and "note" in base:
+            out["note"] = base["note"]
+        json.dump(out, open(baseline_file, "w"), indent=1, sort_keys=True)
     return vs
 
 
@@ -329,7 +343,7 @@ def emit(times, n_total):
                           "unit": "ms", "vs_baseline": 0.0, "n_queries": 0}))
         return
     geomean = _geomean(list(times.values()))
-    vs = resolve_baseline(os.path.join(REPO, ".bench_baseline.json"),
+    vs = resolve_baseline(os.path.join(REPO, "BASELINE_TIMES.json"),
                           times, n_total)
     print(json.dumps({
         "metric": "power_geomean_ms",
@@ -360,7 +374,7 @@ def run_parent(t_entry):
     ensure_data()                                    # once, before the child
     names = [n for n, _ in bench_queries()]
     ordered = order_by_history(names,
-                               os.path.join(REPO, ".bench_baseline.json"))
+                               os.path.join(REPO, "BASELINE_TIMES.json"))
     restarts = 0
 
     def left():

@@ -152,7 +152,8 @@ def generate_data_dist(args, tool_path, range_start, range_end):
         try:
             build = subprocess.run(["make", "-C", ndsrun_dir],
                                    capture_output=True, text=True)
-            err = build.stderr.strip() if build.returncode else ""
+            err = ((build.stderr.strip() or f"make exited {build.returncode}")
+                   if build.returncode else "")
         except OSError as e:              # no make on this host
             err = str(e)
         if err:

@@ -33,10 +33,11 @@ if __name__ == "__main__":
                         help="path to execution time log.",
                         default="")
     parser.add_argument("--input_format",
-                        choices=["parquet", "orc", "csv", "json",
+                        choices=["parquet", "orc", "avro", "csv", "json",
                                  "iceberg", "delta"],
                         default="parquet",
-                        help="type for input data source.")
+                        help="type for input data source "
+                        "(ref: nds/nds_power.py:357-364).")
     parser.add_argument("--output_prefix",
                         help="text to prepend to every output file.")
     parser.add_argument("--output_format",

@@ -44,11 +44,20 @@ def check_build_ndsgen() -> Path:
         # ships in the repo — it would be unreviewable and could drift);
         # a host without make falls through to the $TPCDS_HOME toolkit
         import subprocess
+        build_failed = False
         try:
-            subprocess.run(["make", "-C", str(native.parent)],
-                           capture_output=True, text=True)
+            build = subprocess.run(["make", "-C", str(native.parent)],
+                                   capture_output=True, text=True)
+            if build.returncode:
+                # never run whatever a failed build left behind — fall
+                # through to the $TPCDS_HOME toolkit instead
+                build_failed = True
+                print(f"ndsgen build failed (make exited {build.returncode}):\n"
+                      f"{build.stderr.strip()}")
         except OSError:
             pass
+        if build_failed:
+            native = native / "unbuilt"  # guaranteed not a file
     if native.is_file() and os.access(native, os.X_OK):
         return native
     tpcds_home = os.environ.get("TPCDS_HOME")

@@ -358,8 +358,8 @@ def cast(col: Column, target: str) -> Column:
             uniq, inv = np.unique(strs, return_inverse=True)
             return inv.astype(np.int32), uniq.astype(object)
 
-        from nds_tpu.engine.ops import host_read
-        inv, uniq = host_read("cast_str", fetch)
+        from nds_tpu.engine.ops import timed_read
+        inv, uniq = timed_read("cast_str", fetch)
         return Column("str", jnp.asarray(inv), col.valid, uniq)
     raise ValueError(f"unsupported cast target: {target}")
 
@@ -490,8 +490,8 @@ def fn_concat(cols) -> Column:
         uniq, inv = np.unique(combined.astype(str), return_inverse=True)
         return inv.astype(np.int32), uniq.astype(object)
 
-    from nds_tpu.engine.ops import host_read
-    inv, uniq = host_read("concat", fetch)
+    from nds_tpu.engine.ops import timed_read
+    inv, uniq = timed_read("concat", fetch)
     valid = None
     vs = [c.valid for c in cols if c.valid is not None]
     if vs:

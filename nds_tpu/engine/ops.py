@@ -199,6 +199,22 @@ def host_read(tag: str, fetch):
     return val
 
 
+def timed_read(tag: str, fetch):
+    """host_read() with the fetch charged to the thread's sync/wait
+    accounting — for blocking device->host reads that are not simple
+    scalar syncs (chunk spans, exchange overflow counters, whole-column
+    string/date fetches), so PERF.md's roofline sees them too."""
+
+    def timed():
+        add_syncs()
+        t0 = time.perf_counter_ns()
+        out = fetch()
+        add_sync_wait(time.perf_counter_ns() - t0)
+        return out
+
+    return host_read(tag, timed)
+
+
 def host_sync(value) -> int:
     """Read a device scalar on host, counting the sync."""
 
@@ -1184,6 +1200,29 @@ def join_indices(left_keys, right_keys, how: str = "inner",
     return l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx
 
 
+@jax.jit
+def _semi_sorted_impl(lv, lvalid, rv, rvalid, n_left, n_right):
+    """Sort-based existence probe on directly comparable key views: dead
+    right rows take the sentinel (never exposing their value), live rows
+    sort live-first, so one leftmost searchsorted + equality + liveness
+    check answers "does any LIVE right row hold this value" — exact (no
+    hash, no collision verify), duplicate-tolerant, and sync-free."""
+    plen_r = rv.shape[0]
+    ok_r = jnp.arange(plen_r) < n_right
+    if rvalid is not None:
+        ok_r = ok_r & rvalid
+    dk = jnp.where(ok_r, rv.astype(jnp.int64), _PK_SENTINEL)
+    order = jnp.lexsort((~ok_r, dk))
+    dks = jnp.take(dk, order)
+    lvv = lv.astype(jnp.int64)
+    lo = jnp.clip(jnp.searchsorted(dks, lvv), 0, max(plen_r - 1, 0))
+    hit = (jnp.take(dks, lo) == lvv) & jnp.take(jnp.take(ok_r, order), lo)
+    ok_l = jnp.arange(lv.shape[0]) < n_left
+    if lvalid is not None:
+        ok_l = ok_l & lvalid
+    return hit & ok_l
+
+
 def semi_join_mask(left_keys, right_keys, negate: bool = False,
                    null_safe: bool = False,
                    n_left: int | None = None,
@@ -1193,6 +1232,28 @@ def semi_join_mask(left_keys, right_keys, negate: bool = False,
     Pad rows always come back False."""
     plen_l = len(left_keys[0])
     n_left = plen_l if n_left is None else n_left
+    lk, rk = left_keys[0], right_keys[0]
+    if len(left_keys) == 1 and not null_safe and \
+            lk.kind != "f64" and rk.kind != "f64" and \
+            (lk.kind == rk.kind or
+             {lk.kind, rk.kind} <= {"i64", "date"}):
+        # single integer-comparable key (i64/date/decimal/str ranks): the
+        # sort probe answers existence directly — no candidate-pair sync
+        # (_probe_candidates' total), which is one blocking round trip per
+        # IN/EXISTS subquery on the generic path (DESIGN.md item 2)
+        if lk.kind == "str" and rk.kind == "str":
+            lview, rview = ordered_codes_merged(lk, rk)
+        elif lk.kind != "str" and rk.kind != "str":
+            lview, rview = lk.data, rk.data
+        else:
+            lview = rview = None
+        if lview is not None:
+            plen_r = len(rk)
+            n_r = plen_r if n_right is None else n_right
+            matched = _semi_sorted_impl(lview, lk.valid, rview, rk.valid,
+                                        count_arr(n_left), count_arr(n_r))
+            out = ~matched if negate else matched
+            return out & live_mask(plen_l, n_left)
     l_idx, _, _, _, _, _, _ = join_indices(
         left_keys, right_keys, "inner", null_safe, n_left, n_right)
     matched = jnp.zeros(plen_l, dtype=bool).at[l_idx].set(True, mode="drop")
@@ -1271,7 +1332,7 @@ def _dense_dim_info(dim_key: Column, n_dim: int):
 
         # the host part (the fetched key array -> position map) routes
         # through the replay log; only the device upload stays outside
-        got = host_read("dense_dim", fetch)
+        got = timed_read("dense_dim", fetch)
         if got is None:
             return None
         mn, pos = got
@@ -1477,7 +1538,7 @@ def _chunked_inner_join(left, right, left_keys, right_keys, probe,
         return (_chunk_spans(counts_np, _PAIR_BUDGET),
                 np.concatenate([[0], np.cumsum(counts_np)]))
 
-    spans, cum = host_read("chunk_spans", fetch)
+    spans, cum = timed_read("chunk_spans", fetch)
     parts, schema_chunk = [], None
     for (s, e) in spans:
         span_total = int(cum[e] - cum[s])

@@ -46,6 +46,11 @@ class _NotReplayable(Exception):
     pass
 
 
+try:
+    from jax.core import DropVar as _DropVar
+except ImportError:  # pragma: no cover - future jax relocations
+    from jax.extend.core import DropVar as _DropVar  # type: ignore
+
 import os as _os
 
 _MAX_EQNS = int(_os.environ.get("NDS_TPU_REPLAY_MAX_EQNS", "4500"))
@@ -55,15 +60,95 @@ def _count_eqns(jaxpr) -> int:
     jaxpr = getattr(jaxpr, "jaxpr", jaxpr)     # unwrap ClosedJaxpr
     n = 0
     for eq in jaxpr.eqns:
-        n += 1
-        for v in eq.params.values():
-            if hasattr(v, "jaxpr"):
-                n += _count_eqns(v.jaxpr)
-            elif isinstance(v, (list, tuple)):
-                for x in v:
-                    if hasattr(x, "jaxpr"):
-                        n += _count_eqns(x.jaxpr)
+        n += _eqn_weight(eq)
     return n
+
+
+def _eqn_weight(eq) -> int:
+    """1 + every equation nested in the eqn's sub-jaxprs (pjit bodies,
+    scan/cond branches) — the unit XLA optimization time scales with."""
+    n = 1
+    for v in eq.params.values():
+        if hasattr(v, "jaxpr"):
+            n += _count_eqns(v.jaxpr)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                if hasattr(x, "jaxpr"):
+                    n += _count_eqns(x.jaxpr)
+    return n
+
+
+_MAX_SEGMENTS = int(_os.environ.get("NDS_TPU_REPLAY_MAX_SEGMENTS", "6"))
+
+
+def _split_jaxpr(closed, max_eqns):
+    """Partition a whole-query ClosedJaxpr into sequential segments of
+    bounded optimization weight, each compiled as its OWN XLA program.
+
+    XLA's optimization passes go superlinear on the handful of
+    megaprograms the biggest queries trace to (q14/q67-class); chaining
+    K bounded programs keeps compile time ~linear while still replacing
+    the few-hundred-dispatch eager stream with K dispatches. Returns
+    ``(segments, out_src)`` where each segment is ``(jaxpr, const_vals,
+    invars, outvars)`` and ``out_src`` maps every program output var to
+    its position, or None when the program does not split cleanly
+    (effects, or a single oversized equation)."""
+    from jax.extend import core as jex_core
+    jaxpr = closed.jaxpr
+    if jaxpr.effects:
+        return None
+    weights = [_eqn_weight(eq) for eq in jaxpr.eqns]
+    if not jaxpr.eqns or max(weights) > max_eqns:
+        return None                       # one indivisible giant equation
+    groups, cur, cur_w = [], [], 0
+    for eq, w in zip(jaxpr.eqns, weights):
+        if cur and cur_w + w > max_eqns:
+            groups.append(cur)
+            cur, cur_w = [], 0
+        cur.append(eq)
+        cur_w += w
+    if cur:
+        groups.append(cur)
+    if len(groups) > _MAX_SEGMENTS:
+        return None
+    const_of = dict(zip(jaxpr.constvars, closed.consts))
+    # var -> defining group index (inputs/consts = -1)
+    def_in = {v: -1 for v in list(jaxpr.invars) + list(jaxpr.constvars)}
+    for gi, eqns in enumerate(groups):
+        for eq in eqns:
+            for ov in eq.outvars:
+                def_in[ov] = gi
+    is_var = lambda a: not isinstance(a, jex_core.Literal)  # noqa: E731
+    # vars each group consumes from OUTSIDE itself
+    needs = [[] for _ in groups]
+    for gi, eqns in enumerate(groups):
+        seen = set()
+        for eq in eqns:
+            for iv in eq.invars:
+                if is_var(iv) and def_in[iv] != gi and iv not in seen:
+                    seen.add(iv)
+                    needs[gi].append(iv)
+    # vars that must cross a segment boundary (consumed later or output)
+    final_out = [v for v in jaxpr.outvars if is_var(v)]
+    crossers = set(final_out)
+    for gi in range(len(groups)):
+        crossers.update(v for v in needs[gi] if def_in[v] >= 0)
+    segments = []
+    for gi, eqns in enumerate(groups):
+        invars = needs[gi]
+        outvars = []
+        for eq in eqns:
+            for ov in eq.outvars:
+                if ov in crossers and not isinstance(ov, _DropVar):
+                    outvars.append(ov)
+        seg_consts = [const_of[v] for v in invars if v in const_of]
+        cvars = [v for v in invars if v in const_of]
+        rvars = [v for v in invars if v not in const_of]
+        seg = jex_core.Jaxpr(constvars=cvars, invars=rvars,
+                             outvars=outvars, eqns=eqns,
+                             debug_info=jaxpr.debug_info)
+        segments.append((seg, seg_consts, rvars, outvars))
+    return segments, list(jaxpr.outvars), const_of
 
 
 # log entries whose array payloads are DEVICE OPERANDS (consumed via
@@ -118,6 +203,10 @@ class CompiledQuery:
         self.out_template = out_template
         self.arg_spec = None       # [(table, col, has_valid)]
         self.jitted = None
+        self.segments = None       # chained programs when too big for one
+        self.seg_invars = None
+        self.seg_outsrc = None
+        self.seg_constenv = None
 
     # ---------------------------------------------------------------- build
 
@@ -185,25 +274,56 @@ class CompiledQuery:
 
         # validate the replay log end-to-end with the SAME trace the jit
         # cache will reuse, and gate on program size: a handful of
-        # rollup+window giants (q67-class) trip superlinear XLA
-        # optimization time; they stay on the eager path rather than
-        # stall a compile queue
+        # rollup+window giants (q14/q67-class) trip superlinear XLA
+        # optimization time as ONE program — those split into a chain of
+        # bounded segment programs instead (compile ~linear, K dispatches)
         E.resolve_counts()   # the trace must start with a clean batch
         self.jitted = jax.jit(traced)
         try:
-            jaxpr = self.jitted.trace(
+            closed = self.jitted.trace(
                 self._flat_args(), self.operands).jaxpr
         except AttributeError:  # pragma: no cover - older jax
-            jaxpr = jax.make_jaxpr(traced)(
-                self._flat_args(), self.operands).jaxpr
-        n_eqns = _count_eqns(jaxpr)
+            closed = jax.make_jaxpr(traced)(
+                self._flat_args(), self.operands)
+        n_eqns = _count_eqns(closed.jaxpr)
         if n_eqns > _MAX_EQNS:
             self.jitted = None
-            raise _NotReplayable(
-                f"program too large to fuse profitably: {n_eqns} eqns")
+            split = _split_jaxpr(closed, _MAX_EQNS)
+            if split is None:
+                raise _NotReplayable(
+                    f"program too large to fuse profitably ({n_eqns} eqns) "
+                    "and not cleanly splittable")
+            segs, out_src, const_env = split
+            import functools
+            from jax import core as jcore
+            self.segments = [
+                (jax.jit(functools.partial(jcore.eval_jaxpr, seg)),
+                 consts, invars, outvars)
+                for seg, consts, invars, outvars in segs]
+            self.seg_invars = closed.jaxpr.invars
+            self.seg_outsrc = out_src
+            # a program output may BE a jaxpr constvar (a recorded value
+            # reaching the output untransformed): those never cross a
+            # segment boundary, so the run env must be seeded with them
+            self.seg_constenv = const_env
         return self
 
     # ----------------------------------------------------------------- run
+
+    def _run_segments(self):
+        """Execute the chained segment programs, feeding each segment from
+        an environment of prior outputs (K dispatches instead of 1)."""
+        from jax.extend import core as jex_core
+        import jax.tree_util as jtu
+        leaves = jtu.tree_leaves((self._flat_args(), self.operands))
+        env = dict(self.seg_constenv)
+        env.update(zip(self.seg_invars, leaves))
+        for seg_fn, consts, invars, outvars in self.segments:
+            outs = seg_fn(consts, *[env[v] for v in invars])
+            env.update(zip(outvars, outs))
+        return tuple(
+            v.val if isinstance(v, jex_core.Literal) else env[v]
+            for v in self.seg_outsrc)
 
     def run(self, block: bool = False) -> DeviceTable:
         from nds_tpu.engine.column import Column
@@ -211,7 +331,10 @@ class CompiledQuery:
         # the first call traces: stray real counts must not sit in the
         # pending list where the traced resolve would batch them
         E.resolve_counts()
-        outs = self.jitted(self._flat_args(), self.operands)
+        if self.segments is not None:
+            outs = self._run_segments()
+        else:
+            outs = self.jitted(self._flat_args(), self.operands)
         if block:
             import jax as _jax
             _jax.block_until_ready(outs[-1])

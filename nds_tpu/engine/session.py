@@ -142,19 +142,25 @@ class Session:
                 valid=None if c.valid is None else jax.device_put(c.valid, sh))
         return DeviceTable(cols, table.nrows, plen=table.plen)
 
-    def create_temp_view(self, name: str, table, base: bool = False) -> None:
+    def create_temp_view(self, name: str, table, base: bool = False,
+                         arrow=None) -> None:
         """Register a table. ``base=True`` marks a pristine base-table load
         (raw/columnar/warehouse readers), which lets the planner trust
         schema facts like primary-key uniqueness; any re-registration under
-        the same name through a non-base path revokes the marker."""
+        the same name through a non-base path revokes the marker.
+        ``arrow`` optionally passes the host-side source table so load-time
+        statistics can be collected without any device->host read."""
         from nds_tpu.engine.table import ChunkedTable
         if isinstance(table, pa.Table):
+            arrow = table if arrow is None else arrow
             table = from_arrow(table)
         key = name.lower()
         if isinstance(table, ChunkedTable):
             self.catalog[key] = table        # host-resident; never sharded
         else:
             self.catalog[key] = self._shard_table(table)
+        if base and arrow is not None:
+            self._collect_load_stats(key, arrow)
         if base:
             self.base_tables.add(key)
         else:
@@ -167,6 +173,45 @@ class Session:
         self._replay_seen.clear()
         self._replay_blacklist.clear()
 
+    def _collect_load_stats(self, key: str, arrow) -> None:
+        """Load-time key statistics from HOST data (DESIGN.md item 2: one
+        scan at load instead of a device->host sync at query time).
+
+        Today this prewarms the dense-dimension position map for a table
+        whose FIRST column is a unique dense integer key (every TPC-DS
+        dimension PK is; ref: nds/nds_schema.py surrogate keys), so the
+        first star join against it needs no whole-column device fetch."""
+        import numpy as np
+        t = self.catalog.get(key)
+        if self.mesh is not None or not isinstance(t, DeviceTable) or \
+                not t.columns:
+            return
+        first = next(iter(t.columns))
+        col = t.columns[first]
+        n = t.nrows if isinstance(t.nrows, int) else None
+        if not n or n > (1 << 24) or col.kind == "str" or \
+                first not in arrow.column_names:
+            return
+        src = arrow.column(first)
+        if src.null_count or not pa.types.is_integer(src.type):
+            return
+        live = src.to_numpy(zero_copy_only=False).astype(np.int64)
+        if len(live) != n:
+            return
+        mn = int(live.min())
+        span = int(live.max()) - mn + 1
+        # the same density gate _dense_dim_info applies at query time
+        if span > max(4 * n, 1 << 16) or span > (1 << 26):
+            return
+        pos = np.full(span, n, dtype=np.int64)
+        pos[live - mn] = np.arange(n)
+        if int((pos != n).sum()) != n:
+            return                            # duplicate keys: not a PK
+        from nds_tpu.engine import ops as E
+        import jax.numpy as jnp
+        E._identity_cache(E._dense_dim_cache, 64, (col.data,),
+                          lambda: (mn, jnp.asarray(pos)), static_key=n)
+
     def read_raw_view(self, name: str, path: str, fields) -> float:
         """Register a raw '|'-delimited table; returns elapsed seconds (the
         per-view creation timing in the reference's setup_tables;
@@ -175,7 +220,8 @@ class Session:
         start = time.perf_counter()
         arrow = read_raw_table(path, fields)
         canonical = {f.name: f.type for f in fields}
-        self.create_temp_view(name, from_arrow(arrow, canonical), base=True)
+        self.create_temp_view(name, from_arrow(arrow, canonical), base=True,
+                              arrow=arrow)
         return time.perf_counter() - start
 
     def read_columnar_view(self, name: str, path: str, fmt: str = "parquet",
@@ -198,7 +244,7 @@ class Session:
                 name, ChunkedTable(arrow, canonical_types), base=True)
         else:
             self.create_temp_view(name, from_arrow(arrow, canonical_types),
-                                  base=True)
+                                  base=True, arrow=arrow)
         return time.perf_counter() - start
 
     # -- SQL ----------------------------------------------------------------
@@ -260,7 +306,7 @@ class Session:
                 report_task_failure(
                     "replayed query dispatch (one-off eager fallback)", exc)
         if key in self._replay_seen and key not in self._replay_blacklist \
-                and R.record_eligible(self):
+                and key not in self._replay_cache and R.record_eligible(self):
             E.resolve_counts()   # stray pending counts must not enter the log
             t0 = _time.perf_counter()
             with E.recording() as log:

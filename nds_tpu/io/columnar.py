@@ -1,6 +1,6 @@
 # Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
-"""Columnar table IO: Parquet / ORC / CSV / JSON read+write with hive-style
-date partitioning.
+"""Columnar table IO: Parquet / ORC / Avro / CSV / JSON read+write with
+hive-style date partitioning.
 
 Covers the reference's Load Test output surface (ref: nds/nds_transcode.py:
 69-152): the seven fact tables are date-partitioned, everything else is
@@ -88,6 +88,17 @@ def write_table(table: pa.Table, path: str, fmt: str = "parquet",
         else:
             paorc.write_table(table, os.path.join(path, "part-0.orc"),
                               compression=comp)
+    elif fmt == "avro":
+        from nds_tpu.io.avro import write_avro
+        if partition_col:
+            for part_dir, part in _hive_partition_runs(table, partition_col):
+                sub = os.path.join(path, part_dir)
+                os.makedirs(sub, exist_ok=True)
+                write_avro(part, os.path.join(sub, "part-0.avro"),
+                           compression=compression)
+        else:
+            write_avro(table, os.path.join(path, "part-0.avro"),
+                       compression=compression)
     elif fmt == "csv":
         import pyarrow.csv as pacsv
         pacsv.write_csv(table, os.path.join(path, "part-0.csv"))
@@ -106,6 +117,33 @@ def read_table(path: str, fmt: str = "parquet") -> pa.Table:
     if fmt in ("parquet", "orc"):
         ds = pads.dataset(path, format=fmt, partitioning="hive")
         return ds.to_table()
+    if fmt == "avro":
+        from nds_tpu.io.avro import read_avro
+        parts = []
+        for root, _dirs, files in sorted(os.walk(path)):
+            for fn in sorted(files):
+                if not fn.endswith(".avro"):
+                    continue
+                t = read_avro(os.path.join(root, fn))
+                # restore hive partition columns from the directory path
+                rel = os.path.relpath(root, path)
+                if rel != ".":
+                    for seg in rel.split(os.sep):
+                        col, _, val = seg.partition("=")
+                        if val == "__HIVE_DEFAULT_PARTITION__":
+                            arr = pa.nulls(t.num_rows, type=pa.int64())
+                        else:
+                            try:
+                                arr = pa.array([int(val)] * t.num_rows,
+                                               type=pa.int64())
+                            except ValueError:  # non-integral partition
+                                arr = pa.array([float(val)] * t.num_rows,
+                                               type=pa.float64())
+                        t = t.append_column(col, arr)
+                parts.append(t)
+        if not parts:
+            raise FileNotFoundError(f"no .avro files under {path}")
+        return pa.concat_tables(parts, promote_options="default")
     if fmt == "csv":
         import pyarrow.csv as pacsv
         files = [os.path.join(path, f) for f in sorted(os.listdir(path))

@@ -279,8 +279,8 @@ def exchange_join_pairs(lh, lrow, rh, rrow, mesh, axis: str = "part"):
             step = _exchange_step_cache[key] = _exchange_join_step(
                 mesh, cap_in, pair_cap, axis)
         l_idx, r_idx, live, overs = step(lh, lrow, rh, rrow)
-        from nds_tpu.engine.ops import host_read
-        lo, ro, po = host_read(
+        from nds_tpu.engine.ops import timed_read
+        lo, ro, po = timed_read(
             "exch_overs", lambda: tuple(int(x) for x in overs))
         if lo == 0 and ro == 0 and po == 0:
             return l_idx, r_idx, live

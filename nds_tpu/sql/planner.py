@@ -1333,24 +1333,49 @@ class Planner:
                                        key_cols, key_names, table)
         if set_tables is not None:
             pass
+        elif not group_exprs and group_by.kind == "plain" and \
+                not any(c.distinct or c.name == "approx_count_distinct"
+                        for c in agg_calls.values()):
+            # GLOBAL aggregate: the output row count is statically 1 and
+            # SQL's empty-input semantics already live in the aggregates'
+            # device-side validity (a zero-contribution group yields
+            # count 0 and NULL sum/min/max) — so the input count is never
+            # resolved on host. q9-class queries pay one sync per scalar
+            # subquery through the generic arm; this path pays none.
+            ng, cap = 1, E.bucket_len(1)
+            gids = jnp.where(E.live_mask(table.plen, table.nrows),
+                             0, cap).astype(jnp.int64)
+            agg_vals = {akey: self._compute_agg(call, base_ctx, gids,
+                                                cap, [])
+                        for akey, call in agg_calls.items()}
+            set_tables = [self._finish_set(sel, set(), key_names, key_cols,
+                                           {}, agg_vals, ng, cap)]
         else:
-            # SQL's empty-input semantics (a GLOBAL aggregate over zero rows
-            # still yields one row) need the exact count, not the bound; the
-            # resolve is batched with every lazy count pending upstream
-            n_input = E.count_int(table.nrows)
             set_tables = []
             for gset in group_by.sets:
                 gset_keys = [expr_key(e) for e in gset]
                 active = [key_cols[i] for i, k in enumerate(key_names)
                           if k in gset_keys]
-                if n_input == 0:
-                    # empty input: global agg still yields one row
-                    if active or group_by.kind != "plain" or group_exprs:
-                        continue
                 if active:
+                    # group_ids' ngroups resolve DRAINS every pending lazy
+                    # count — including the input count — so the empty-
+                    # input test rides the same transfer (ng == 0 iff no
+                    # live input rows): ONE sync per grouping set, not two
                     gids, ng, rep, cap = E.group_ids(active,
                                                      n_valid=table.nrows)
+                    if ng == 0:
+                        # keyed set over empty input contributes no rows
+                        continue
                 else:
+                    # keyless set: inside rollup/cube/grouping-sets an
+                    # empty input contributes no row; a PLAIN keyless
+                    # aggregate (only distinct aggs reach this arm) still
+                    # yields one row over empty input. A sibling keyed
+                    # set usually resolved the input count already,
+                    # making this test free.
+                    if E.count_int(table.nrows) == 0 and \
+                            (group_by.kind != "plain" or group_exprs):
+                        continue
                     # global aggregate: live rows in group 0, pads in a
                     # dropped trailing slot
                     ng, cap = 1, E.bucket_len(1)
@@ -1358,8 +1383,7 @@ class Planner:
                                      0, cap).astype(jnp.int64)
                     rep = jnp.zeros(cap, dtype=jnp.int64)
                 group_cols = {
-                    k: (key_cols[i].take(rep) if n_input
-                        else X.literal(None, cap))
+                    k: key_cols[i].take(rep)
                     for i, k in enumerate(key_names) if k in gset_keys}
                 # aggregates (segment capacity = cap keeps shapes canonical;
                 # pad contributions land past ng or are dropped)
@@ -1528,10 +1552,18 @@ class Planner:
         return Column("f64", out, c.data > 0)
 
     def _mask_ctx(self, ctx: EvalCtx, mask) -> EvalCtx:
-        """Compact an aggregation context by a boolean mask (HAVING)."""
+        """Compact an aggregation context by a boolean mask (HAVING).
+
+        LAZY (DESIGN.md item 1): HAVING can only shrink, so the input's
+        bound is a valid capacity — live rows gather to the prefix of the
+        bound-sized bucket and the exact count rides as a DeviceCount,
+        resolved batched by whatever downstream consumer truly needs it
+        (ORDER BY/LIMIT, collect). No sync here."""
         m = mask & E.live_mask(ctx.table.plen, ctx.table.nrows)
-        n = E.host_sync(jnp.sum(m))    # counted + replay-logged
-        idx = E.compact_indices(m, n)
+        bound = E.count_bound(ctx.table.nrows)
+        n = E.DeviceCount(jnp.sum(m), bound)
+        cap = E.bucket_len(bound)
+        idx = jnp.nonzero(m, size=cap, fill_value=ctx.table.plen)[0]
         new = EvalCtx(DeviceTable(
             {nm: c.take(idx) for nm, c in ctx.table.columns.items()}, n,
             plen=int(idx.shape[0])), post_agg=True)
@@ -1543,12 +1575,14 @@ class Planner:
 
     def _compute_agg(self, call: A.FuncCall, base_ctx: EvalCtx, gids, ng, key_cols):
         name = call.name
-        # memoized by the _aggregate-time resolve: no extra sync here
-        n_base = E.count_int(base_ctx.table.nrows)
         if name == "count" and call.star:
             return E.agg_count(None, gids, ng)
         arg = self.eval_expr(call.args[0], base_ctx) if call.args else None
         if call.distinct:
+            # only the distinct re-grouping needs the exact host count
+            # (memoized by the generic arm's resolve when it ran; the
+            # sync-free global arm never reaches here with distinct)
+            n_base = E.count_int(base_ctx.table.nrows)
             if name == "count":
                 return self._count_distinct(arg, gids, ng, n_base)
             if name in ("sum", "avg"):
@@ -1570,7 +1604,8 @@ class Planner:
             sd = E.agg_stddev_samp(arg, gids, ng)
             return Column("f64", sd.data * sd.data, sd.valid)
         if name == "approx_count_distinct":
-            return self._count_distinct(arg, gids, ng, n_base)
+            return self._count_distinct(arg, gids, ng,
+                                        E.count_int(base_ctx.table.nrows))
         raise ExecError(f"unsupported aggregate {name}")
 
     def _count_distinct(self, arg: Column, gids, ng, n_base: int):
@@ -1820,7 +1855,7 @@ class Planner:
                    - _EPOCH64).astype(int) + new_dom
             return out.astype(np.int32)
 
-        out = E.host_read("month_arith", fetch)
+        out = E.timed_read("month_arith", fetch)
         return Column("date", jnp.asarray(out), base.valid)
 
     def _eval_in_list(self, e: A.InList, ctx: EvalCtx) -> Column:
@@ -1950,7 +1985,7 @@ class Planner:
                     out = dom
             return out.astype(np.int64)
 
-        return Column("i64", jnp.asarray(E.host_read("date_part", fetch)),
+        return Column("i64", jnp.asarray(E.timed_read("date_part", fetch)),
                       col.valid)
 
     def _const_int(self, e) -> int:

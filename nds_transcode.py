@@ -129,7 +129,8 @@ if __name__ == "__main__":
     parser.add_argument("report_file",
                         help="location to store a performance report (local)")
     parser.add_argument("--output_format",
-                        choices=["parquet", "orc", "csv", "iceberg", "delta"],
+                        choices=["parquet", "orc", "avro", "csv", "iceberg",
+                                 "delta"],
                         default="parquet",
                         help="output data format")
     parser.add_argument("--tables", nargs="+",

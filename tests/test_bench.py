@@ -5,6 +5,8 @@ import importlib.util
 import json
 import os
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 spec = importlib.util.spec_from_file_location(
@@ -17,12 +19,39 @@ def _times(ms, n, start=0):
     return {f"query{i}": float(ms) for i in range(start, start + n)}
 
 
+@pytest.fixture(autouse=True)
+def _allow_seed(monkeypatch):
+    # tests exercise lineage mechanics from scratch; production refuses a
+    # missing baseline unless seeding is explicit (see the refusal test)
+    monkeypatch.setenv("NDS_BENCH_SEED_BASELINE", "1")
+
+
 class TestResolveBaseline:
     def test_first_full_run_writes_baseline(self, tmp_path):
         f = tmp_path / "base.json"
         vs = bench.resolve_baseline(str(f), _times(100, 99), 99)
         assert vs == 1.0
         assert json.load(open(f))["n_queries"] == 99
+
+    def test_missing_baseline_refused_without_explicit_seed(
+            self, tmp_path, monkeypatch):
+        """Losing the committed lineage must be LOUD, not a silent
+        restart: vs_baseline degrades to 0.0 and nothing is written
+        (round-3 verdict weak #1)."""
+        monkeypatch.delenv("NDS_BENCH_SEED_BASELINE", raising=False)
+        f = tmp_path / "base.json"
+        vs = bench.resolve_baseline(str(f), _times(100, 99), 99)
+        assert vs == 0.0
+        assert not f.exists()
+
+    def test_note_field_survives_merge(self, tmp_path):
+        f = tmp_path / "base.json"
+        bench.resolve_baseline(str(f), _times(100, 95), 99)
+        d = json.load(open(f))
+        d["note"] = "lineage provenance"
+        json.dump(d, open(f, "w"))
+        bench.resolve_baseline(str(f), _times(90, 99), 99)
+        assert json.load(open(f))["note"] == "lineage provenance"
 
     def test_same_set_compares(self, tmp_path):
         f = tmp_path / "base.json"

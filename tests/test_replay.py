@@ -74,6 +74,24 @@ def test_replay_invalidation_on_catalog_change(replay_session, rng):
     assert not key_hit or key_hit[0][1] == s._data_version
 
 
+def test_replay_segmented_when_program_too_big(replay_session, monkeypatch):
+    """A trace past the single-program equation gate must SPLIT into a
+    chain of bounded segment programs (compile stays ~linear) and replay
+    with identical rows — the 'replay total' path the q14/q67-class
+    megaqueries take instead of permanent eager fallback."""
+    monkeypatch.setattr("nds_tpu.engine.replay._MAX_EQNS", 150)
+    s = replay_session
+    r1 = s.sql(Q).collect()
+    r2 = s.sql(Q).collect()          # record + compile (segmented)
+    assert s._replay_cache, "compile fell back despite splitter"
+    cq = next(iter(s._replay_cache.values()))
+    assert cq.segments is not None and len(cq.segments) >= 2, \
+        "expected a chained multi-segment program"
+    r3 = s.sql(Q).collect()          # chained replay
+    assert r1 == r2 == r3
+    assert r1
+
+
 def test_replay_off_by_default_on_cpu(rng, monkeypatch):
     monkeypatch.setenv("NDS_TPU_REPLAY", "auto")
     from nds_tpu.engine.session import Session

@@ -112,6 +112,45 @@ def test_device_count_refuses_implicit_host_use():
     assert d.to_int() == 1
 
 
+def test_scalar_subquery_aggregates_sync_free(star_session):
+    """q9-class queries run 15 scalar subqueries, each a GLOBAL aggregate:
+    the keyless-aggregate arm must never resolve the input count (empty-
+    input semantics ride the aggregates' device-side validity), so the
+    whole query costs only the final output resolution."""
+    before = _syncs()
+    rows = star_session.sql("""
+        select case when (select count(*) from store_sales
+                          where ss_ext_sales_price < 100) > 100
+               then (select avg(ss_ext_sales_price) from store_sales
+                     where ss_item_sk < 120)
+               else (select avg(ss_ext_sales_price) from store_sales
+                     where ss_item_sk >= 120) end x,
+               (select sum(ss_ext_sales_price) from store_sales
+                where ss_sold_date_sk < 100) y
+        from date_dim where d_date_sk = 1
+    """).collect()
+    used = _syncs() - before
+    assert rows
+    assert used <= 2, \
+        f"4 scalar subqueries used {used} host syncs (budget 2)"
+
+
+def test_in_subquery_sync_free(star_session):
+    """Single-key IN (subquery) must take the sort-probe path: existence
+    is answered on device with no candidate-pair sizing sync."""
+    before = _syncs()
+    rows = star_session.sql("""
+        select count(*) c from store_sales
+        where ss_sold_date_sk in
+              (select d_date_sk from date_dim where d_moy = 11)
+          and ss_item_sk not in
+              (select i_item_sk from item where i_brand_id = 1001)
+    """).collect()
+    used = _syncs() - before
+    assert rows and rows[0][0] > 0
+    assert used <= 1, f"IN-subquery query used {used} host syncs (budget 1)"
+
+
 def test_outer_join_sync_budget(rng):
     """A left join's pair + outer-extra counts must resolve in one batched
     transfer: probe sync + one batch = 2, vs 4 pre-batching."""

@@ -353,7 +353,8 @@ def main():
         tag = "" if (q_scale == SCALE and q_seed == default_seed) else \
             f" [sf{q_scale} seed{q_seed}]"
         try:
-            oracle_rows = execute_oracle(con, sql)
+            oracle_rows = execute_oracle(
+                con, sql, timeout_s=ov.get("timeout_s"))
         except sqlite3.Error as e:
             skipped.append((q, f"sqlite: {e}"))
             print(f"SKIP {q:16s} sqlite: {str(e)[:90]}", flush=True)

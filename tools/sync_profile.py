@@ -1,0 +1,86 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Per-query host-sync site profiler (dev tool for DESIGN.md items 2/4).
+
+Runs queries from a generated stream on the CPU backend with every
+``ops.host_read`` fetch attributed to its call site, and prints a per-query
+histogram of sync sites — the measurement behind the sync-tail reduction
+work (which sites dominate q9/q14/q58/q77/q83).
+
+Usage: JAX_PLATFORMS=cpu python tools/sync_profile.py query9 query83 ...
+"""
+
+import collections
+import os
+import sys
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SCALE = os.environ.get("NDS_BENCH_SCALE", "0.01")
+
+
+def main():
+    wanted = sys.argv[1:]
+    from nds_tpu.engine import ops as E
+    from nds_tpu.engine.session import Session
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.power import gen_sql_from_stream
+
+    sites = collections.Counter()
+    real_read = E.host_read
+
+    def traced_read(tag, fetch):
+        def wrapped():
+            before = E.sync_count()
+            out = fetch()
+            if E.sync_count() != before:
+                # attribute to the closest engine frame above ops.py
+                for fr in reversed(traceback.extract_stack()[:-2]):
+                    if "/nds_tpu/" in fr.filename and \
+                            not fr.filename.endswith("ops.py"):
+                        where = f"{os.path.basename(fr.filename)}:" \
+                                f"{fr.lineno}:{fr.name}"
+                        break
+                else:
+                    where = "?"
+                sites[(tag, where)] += E.sync_count() - before
+            return out
+        return real_read(tag, wrapped)
+
+    # every call site resolves host_read/timed_read through the ops module
+    # attribute at call time, so one rebind profiles them all
+    E.host_read = traced_read
+
+    pq = os.path.join(REPO, ".bench_cache", f"sf{SCALE}_parquet")
+    stream = None
+    cache_root = os.path.join(REPO, ".bench_cache")
+    for d in sorted(os.listdir(cache_root)):
+        if d.startswith(f"stream_sf{SCALE}"):
+            stream = os.path.join(cache_root, d, "query_0.sql")
+    assert stream and os.path.exists(stream), "run bench.py once to seed data"
+    queries = gen_sql_from_stream(stream)
+
+    sess = Session()
+    for table, fields in get_schemas(use_decimal=True).items():
+        path = os.path.join(pq, f"{table}.parquet")
+        if os.path.exists(path):
+            sess.read_columnar_view(
+                table, path, "parquet",
+                canonical_types={f.name: f.type for f in fields})
+
+    for name in (wanted or queries):
+        sql = queries[name]
+        sites.clear()
+        s0 = E.sync_count()
+        sess.sql(sql).collect()
+        used = E.sync_count() - s0
+        print(f"\n== {name}: {used} syncs ==")
+        for (tag, where), n in sites.most_common():
+            print(f"  {n:3d}  {tag:12s} {where}")
+
+
+if __name__ == "__main__":
+    main()
