@@ -184,7 +184,10 @@ def run_server():
             print(json.dumps({
                 "name": name, "ms": ms, "hostSyncs": syncs,
                 "syncWaitMs": round(sync_ms, 1), "scanBytes": scan,
-                "scanGBps": round(gbps, 3)}), flush=True)
+                "scanGBps": round(gbps, 3),
+                # warm pass wall = XLA compile (+1 exec): the per-query
+                # compile-cost axis the SF10 scaling question turns on
+                "warmS": round(t0 - tw, 2)}), flush=True)
         except Exception as e:                        # keep serving
             print(json.dumps({"name": name,
                               "error": f"{type(e).__name__}: {e}"[:300]}),
@@ -319,11 +322,12 @@ def write_perf(times, perf):
                 f"Aggregate: {len(times)} queries, "
                 f"{tot_sync / max(tot_ms, 1e-9) * 100:.1f}% of summed wall "
                 "time blocked on device->host reads.\n\n")
-        f.write("| query | wall ms | host syncs | sync wait ms | "
-                "scan MB | scan GB/s |\n|---|---|---|---|---|---|\n")
+        f.write("| query | wall ms | warm s | host syncs | sync wait ms | "
+                "scan MB | scan GB/s |\n|---|---|---|---|---|---|---|\n")
         for q in rows:
             p = perf.get(q, {})
-            f.write(f"| {q} | {times[q]:.0f} | {p.get('hostSyncs', '-')} | "
+            f.write(f"| {q} | {times[q]:.0f} | {p.get('warmS', '-')} | "
+                    f"{p.get('hostSyncs', '-')} | "
                     f"{p.get('syncWaitMs', '-')} | "
                     f"{p.get('scanBytes', 0) / 1e6:.1f} | "
                     f"{p.get('scanGBps', '-')} |\n")
@@ -403,7 +407,7 @@ def run_parent(t_entry):
             times[msg["name"]] = msg["ms"]
             perf[msg["name"]] = {k: msg[k] for k in
                                  ("hostSyncs", "syncWaitMs", "scanBytes",
-                                  "scanGBps") if k in msg}
+                                  "scanGBps", "warmS") if k in msg}
         else:
             print(f"# {name} failed: {msg.get('error')}", file=sys.stderr)
     child.stop()

@@ -267,6 +267,7 @@ class DeviceCount:
                 return out
 
             self._host = host_read("count1", fetch)
+            _run_deferred_checks()
         return self._host
 
     def __repr__(self):
@@ -300,6 +301,7 @@ def resolve_counts() -> None:
     pend = [c for c in lst if c._host is None]
     if not pend:
         lst.clear()
+        _run_deferred_checks()   # checks on already-resolved counts
         return
 
     def fetch():
@@ -315,6 +317,42 @@ def resolve_counts() -> None:
     for c, v in zip(pend, vals):
         c._host = v
     lst.clear()
+    _run_deferred_checks()
+
+
+def defer_check(count: DeviceCount, fn) -> None:
+    """Register a validation against a count's eventual host value; it
+    runs at whichever batched resolution produces the value. Keeps SQL
+    runtime-error semantics (e.g. 'scalar subquery returned more than one
+    row') without spending a dedicated sync on the check."""
+    lst = getattr(_sync_tls, "checks", None)
+    if lst is None:
+        lst = _sync_tls.checks = []
+    lst.append((count, fn))
+
+
+def _run_deferred_checks() -> None:
+    lst = getattr(_sync_tls, "checks", None)
+    if not lst:
+        return
+    ready = [(c, f) for c, f in lst if c._host is not None]
+    _sync_tls.checks = [(c, f) for c, f in lst if c._host is None]
+    first_err = None
+    for c, f in ready:          # every ready check runs even if one raises
+        try:
+            f(c._host)
+        except Exception as e:
+            first_err = first_err or e
+    if first_err is not None:
+        raise first_err
+
+
+def flush_deferred_checks() -> None:
+    """Statement-end barrier: resolve any counts that deferred checks are
+    waiting on so SQL runtime errors surface inside the statement that
+    caused them, never attributed to a later one."""
+    if getattr(_sync_tls, "checks", None):
+        resolve_counts()
 
 
 def count_int(n) -> int:

@@ -28,8 +28,9 @@ whole query into one program and REPLAY it. Mechanics:
 Safety: the replay cache is keyed on (query text, session data version);
 any catalog mutation bumps the version. A divergence between trace and
 recording raises ``ops.ReplayMismatch`` and the query permanently falls
-back to the eager path. Streaming (>HBM ChunkedTable) scans never enter
-the cache — their chunk loop is host-driven by design.
+back to the eager path. A query that binds a streaming (>HBM
+ChunkedTable) scan is blacklisted to the eager chunk loop at compile
+time; other queries in the same session replay normally.
 """
 
 from __future__ import annotations
@@ -231,13 +232,16 @@ class CompiledQuery:
             if isinstance(t, DeviceTable) and \
                     isinstance(t.nrows, E.DeviceCount):
                 t.nrows = t.nrows.to_int()
-        # argument universe: every device table in the catalog (chunked
-        # tables disqualified the query before we get here)
+        # argument universe: every DEVICE table in the catalog. Host-
+        # resident ChunkedTables are left out: a query that binds one
+        # fails the compile trace (missing from the rebuilt catalog) and
+        # is blacklisted to the eager chunk loop, while every other query
+        # in the same >HBM session stays replay-eligible.
         self.arg_spec = []
         for tname in sorted(catalog):
             t = catalog[tname]
             if not isinstance(t, DeviceTable):
-                raise _NotReplayable(f"{tname} is not device-resident")
+                continue
             for cname, col in t.columns.items():
                 self.arg_spec.append((tname, cname, col.valid is not None))
         spec = self.arg_spec
@@ -321,9 +325,12 @@ class CompiledQuery:
         for seg_fn, consts, invars, outvars in self.segments:
             outs = seg_fn(consts, *[env[v] for v in invars])
             env.update(zip(outvars, outs))
+        import jax.numpy as jnp
+        # literal outputs carry raw trace-time scalars (TypedInt); jit
+        # would have returned arrays, so the chained path must too
         return tuple(
-            v.val if isinstance(v, jex_core.Literal) else env[v]
-            for v in self.seg_outsrc)
+            jnp.asarray(v.val) if isinstance(v, jex_core.Literal)
+            else env[v] for v in self.seg_outsrc)
 
     def run(self, block: bool = False) -> DeviceTable:
         from nds_tpu.engine.column import Column
@@ -332,7 +339,15 @@ class CompiledQuery:
         # pending list where the traced resolve would batch them
         E.resolve_counts()
         if self.segments is not None:
-            outs = self._run_segments()
+            # the jaxpr's outvars are the FLAT leaves (None valids are
+            # dropped by tracing); re-expand to the (data, valid)*N +
+            # count layout run() consumes using the template's flags
+            flat = list(self._run_segments())
+            outs = []
+            for has_valid in valided:
+                outs.append(flat.pop(0))
+                outs.append(flat.pop(0) if has_valid else None)
+            outs.append(flat.pop(0))
         else:
             outs = self.jitted(self._flat_args(), self.operands)
         if block:
@@ -356,6 +371,9 @@ def out_template_of(table: DeviceTable):
 
 
 def record_eligible(session) -> bool:
-    """Only fully device-resident catalogs replay (a ChunkedTable's chunk
-    loop is host-driven)."""
-    return all(isinstance(t, DeviceTable) for t in session.catalog.values())
+    """Recording is attempted per QUERY, not per catalog: a session with
+    >HBM ChunkedTables still replays every query that binds only device
+    tables (a query that does bind a chunked scan fails its compile trace
+    and is blacklisted to the eager chunk loop — see
+    ``CompiledQuery.compile``)."""
+    return True

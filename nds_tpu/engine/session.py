@@ -340,9 +340,16 @@ class Session:
         # binds (read by the Power Run's per-query summaries)
         self.last_scanned = planner.scanned
         if isinstance(stmt, A.Query):
-            if self._replay_on():
-                return self._sql_replay(text, stmt, planner)
-            return Result(planner.query(stmt))
+            from nds_tpu.engine import ops as E
+            try:
+                if self._replay_on():
+                    return self._sql_replay(text, stmt, planner)
+                return Result(planner.query(stmt))
+            finally:
+                # statement-end barrier: deferred SQL runtime checks
+                # (lazy scalar subqueries) must raise HERE, not inside a
+                # later statement's first resolution
+                E.flush_deferred_checks()
         if isinstance(stmt, A.CreateTempView):
             # route through create_temp_view so a meshed session re-shards
             # the view like every other catalog entry

@@ -1562,8 +1562,7 @@ class Planner:
         m = mask & E.live_mask(ctx.table.plen, ctx.table.nrows)
         bound = E.count_bound(ctx.table.nrows)
         n = E.DeviceCount(jnp.sum(m), bound)
-        cap = E.bucket_len(bound)
-        idx = jnp.nonzero(m, size=cap, fill_value=ctx.table.plen)[0]
+        idx = E.compact_indices(m, bound)
         new = EvalCtx(DeviceTable(
             {nm: c.take(idx) for nm, c in ctx.table.columns.items()}, n,
             plen=int(idx.shape[0])), post_agg=True)
@@ -2193,6 +2192,26 @@ class Planner:
         if found is None:
             rt = self.query(e.query)
             col = rt[rt.column_names[0]]
+            if isinstance(rt.nrows, E.DeviceCount):
+                # LAZY scalar: broadcast row 0 with device-side validity
+                # (empty subquery -> NULL via nd >= 1); the "more than one
+                # row" error check rides the next batched resolution
+                # instead of spending a sync here (q58-class queries pay
+                # one per scalar subquery otherwise)
+                nd = rt.nrows.dev
+                ok = nd >= 1
+                if col.valid is not None:
+                    ok = ok & col.valid[0]
+                data = jnp.broadcast_to(col.data[0], (n,))
+                valid = jnp.broadcast_to(ok, (n,))
+
+                def check(v):
+                    if v > 1:
+                        raise ExecError(
+                            "scalar subquery returned more than one row")
+
+                E.defer_check(rt.nrows, check)
+                return Column(col.kind, data, valid, col.dict_values)
             n_rt = E.count_int(rt.nrows)     # host semantics: exact count
             if n_rt == 0:
                 return X.literal(None, n)

@@ -92,6 +92,31 @@ def test_replay_segmented_when_program_too_big(replay_session, monkeypatch):
     assert r1
 
 
+def test_chunked_table_does_not_disable_replay_for_others(
+        replay_session, monkeypatch, rng):
+    """A >HBM streamed table in the catalog must not strip OTHER queries
+    of replay; a query binding the chunked scan itself stays on the eager
+    chunk loop with correct rows."""
+    import pyarrow as pa
+    from nds_tpu.engine.table import ChunkedTable
+    s = replay_session
+    big = pa.table({"bk": pa.array(rng.integers(0, 50, 5_000), pa.int64()),
+                    "bv": pa.array(rng.integers(0, 100, 5_000), pa.int64())})
+    s.create_temp_view("big", ChunkedTable(big, chunk_rows=1024), base=True)
+    r1 = s.sql(Q).collect()
+    s.sql(Q).collect()
+    r3 = s.sql(Q).collect()
+    assert s._replay_cache, "device-only query lost replay eligibility"
+    assert r1 == r3
+    qb = "select bk, sum(bv) s from big where bk < 10 group by bk order by bk"
+    b1 = s.sql(qb).collect()
+    s.sql(qb).collect()
+    b3 = s.sql(qb).collect()
+    assert b1 == b3 and len(b1) == 10
+    assert not any(k[0] == qb for k in s._replay_cache), \
+        "chunked-scan query must stay on the eager chunk loop"
+
+
 def test_replay_off_by_default_on_cpu(rng, monkeypatch):
     monkeypatch.setenv("NDS_TPU_REPLAY", "auto")
     from nds_tpu.engine.session import Session

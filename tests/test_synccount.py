@@ -151,6 +151,24 @@ def test_in_subquery_sync_free(star_session):
     assert used <= 1, f"IN-subquery query used {used} host syncs (budget 1)"
 
 
+def test_lazy_scalar_subquery_semantics(star_session):
+    """The lazy (sync-free) scalar-subquery arm must keep SQL semantics:
+    empty subquery -> NULL, multi-row subquery -> runtime error (raised at
+    the deferred batched resolution, still inside the same statement)."""
+    from nds_tpu.sql.planner import ExecError
+    rows = star_session.sql("""
+        select d_year, (select i_brand_id from item where i_item_sk = -5) b
+        from date_dim where d_date_sk = 1
+    """).collect()
+    assert rows and rows[0][1] is None
+    with pytest.raises(ExecError, match="more than one row"):
+        star_session.sql("""
+            select d_year, (select i_brand_id from item
+                            where i_item_sk < 10) b
+            from date_dim where d_date_sk = 1
+        """).collect()
+
+
 def test_outer_join_sync_budget(rng):
     """A left join's pair + outer-extra counts must resolve in one batched
     transfer: probe sync + one batch = 2, vs 4 pre-batching."""

@@ -1,0 +1,94 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Hunt (seed, scale) parameter overrides that make vacuous oracle queries
+return rows (round-3 verdict weak #6: 13 zero-row passes).
+
+Runs the SQLITE side only — loading each candidate scale's dataset once
+and sweeping generated parameter seeds per query — because a zero-row
+result is a property of (query params, data), not of the engine; the
+engine side is then re-validated by tools/oracle_validate.py with the
+override in place.
+
+Usage:
+    python tools/oracle_seed_hunt.py query8 query34 ...
+    python tools/oracle_seed_hunt.py            # the round-3 vacuous set
+Prints one line per hit; merge winners into tools/oracle_params.json.
+"""
+
+import json
+import os
+import sqlite3
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+VACUOUS_R3 = [
+    "query8", "query14_part2", "query21", "query23_part2", "query24_part1",
+    "query24_part2", "query34", "query39_part1", "query53", "query63",
+    "query84", "query85", "query91",
+]
+SCALES = [s.strip() for s in os.environ.get(
+    "NDS_HUNT_SCALES", "0.05,0.2,1").split(",")]
+SEEDS = [int(s) for s in os.environ.get(
+    "NDS_HUNT_SEEDS",
+    "19620718,1,2,3,5,8,13,21,34,55,89,144,233,377,610,987").split(",")]
+
+
+def main():
+    want = sys.argv[1:] or VACUOUS_R3
+    from nds_tpu.queries import generate_query_streams
+    from nds_tpu.power import gen_sql_from_stream
+    from tools.oracle_validate import (DIALECT_SKIPS, execute_oracle,
+                                       load_sqlite)
+
+    found: dict = {}
+    for scale in SCALES:
+        remaining = [q for q in want if q not in found
+                     and q not in DIALECT_SKIPS]
+        if not remaining:
+            break
+        os.environ["NDS_SWEEP_SCALE"] = scale
+        import importlib
+
+        import tools.coverage_sweep as CS
+        importlib.reload(CS)
+        data_dir = CS.ensure_data()
+        con = load_sqlite(data_dir)
+        print(f"# scale {scale}: hunting {remaining}", flush=True)
+        for seed in SEEDS:
+            remaining = [q for q in remaining if q not in found]
+            if not remaining:
+                break
+            d = os.path.join(REPO, ".bench_cache",
+                             f"oracle_stream_s{seed}_sf{scale}")
+            os.makedirs(d, exist_ok=True)
+            f = os.path.join(d, "query_0.sql")
+            if not os.path.exists(f):
+                generate_query_streams(d, streams=1, rngseed=seed,
+                                       scale=float(scale))
+            queries = gen_sql_from_stream(f)
+            for q in remaining:
+                try:
+                    rows = execute_oracle(con, queries[q], timeout_s=240)
+                except sqlite3.Error as e:
+                    print(f"#   {q} sf{scale} seed{seed}: sqlite {e}",
+                          flush=True)
+                    continue
+                if rows:
+                    found[q] = {"seed": seed, "scale": scale,
+                                "rows": len(rows)}
+                    print(f"HIT {q}: seed={seed} scale={scale} "
+                          f"rows={len(rows)}", flush=True)
+        con.close()
+    print(json.dumps({"overrides": {
+        q: {"seed": v["seed"], "scale": v["scale"]}
+        for q, v in found.items()}}, indent=1))
+    missing = [q for q in want if q not in found
+               and q not in DIALECT_SKIPS]
+    if missing:
+        print(f"# still empty everywhere hunted: {missing}")
+
+
+if __name__ == "__main__":
+    main()
