@@ -136,11 +136,20 @@ class _ReplaySession:
         if self.mode == "replay":
             # counts created while TRACING hold tracer scalars; they must
             # never reach a later eager device_get — keep only the entries
-            # that already existed when the trace began
+            # that already existed when the trace began. Same for deferred
+            # checks registered against tracer counts: left in place they
+            # could never resolve and would force a spurious resolve at
+            # every later statement's flush.
             lst = _pending_counts()
             keep = [c for c in lst
                     if any(c is s for s in self._pend_snapshot)]
             lst[:] = keep
+            checks = getattr(_sync_tls, "checks", None)
+            if checks:
+                _sync_tls.checks = [
+                    (c, f) for c, f in checks
+                    if any(c is s for s in self._pend_snapshot)
+                    or c._host is not None]
         (_sync_tls.replay_mode, _sync_tls.replay_log,
          _sync_tls.replay_cursor) = self._prev
         _sync_tls.replay_operands = self._prev_ops
@@ -353,6 +362,13 @@ def flush_deferred_checks() -> None:
     caused them, never attributed to a later one."""
     if getattr(_sync_tls, "checks", None):
         resolve_counts()
+
+
+def discard_deferred_checks() -> None:
+    """Drop pending deferred checks — called when a statement aborts
+    with its own exception, so its half-registered checks neither mask
+    the real error nor leak into the next statement."""
+    _sync_tls.checks = []
 
 
 def count_int(n) -> int:

@@ -319,6 +319,10 @@ class Session:
                 _jax.block_until_ready(
                     next(iter(table.columns.values())).data)
             eager_s = _time.perf_counter() - t0
+            # deferred SQL runtime checks from the record pass must raise
+            # NOW: inside compile() they would be swallowed by the
+            # blacklist handler below and the error lost for good
+            E.flush_deferred_checks()
             try:
                 cq = R.CompiledQuery(self, stmt, log,
                                      R.out_template_of(table)).compile()
@@ -343,13 +347,19 @@ class Session:
             from nds_tpu.engine import ops as E
             try:
                 if self._replay_on():
-                    return self._sql_replay(text, stmt, planner)
-                return Result(planner.query(stmt))
-            finally:
-                # statement-end barrier: deferred SQL runtime checks
-                # (lazy scalar subqueries) must raise HERE, not inside a
-                # later statement's first resolution
-                E.flush_deferred_checks()
+                    out = self._sql_replay(text, stmt, planner)
+                else:
+                    out = Result(planner.query(stmt))
+            except BaseException:
+                # a failed statement's half-registered checks must not
+                # mask its real error or leak into the next statement
+                E.discard_deferred_checks()
+                raise
+            # statement-end barrier: deferred SQL runtime checks (lazy
+            # scalar subqueries) raise HERE, never inside a later
+            # statement's first resolution
+            E.flush_deferred_checks()
+            return out
         if isinstance(stmt, A.CreateTempView):
             # route through create_temp_view so a meshed session re-shards
             # the view like every other catalog entry

@@ -117,6 +117,18 @@ def test_chunked_table_does_not_disable_replay_for_others(
         "chunked-scan query must stay on the eager chunk loop"
 
 
+def test_replay_record_tier_preserves_scalar_subquery_error(replay_session):
+    """A multi-row scalar subquery must raise its SQL runtime error on
+    EVERY execution tier — the record tier's compile handler must not
+    swallow the deferred check into a silent blacklist."""
+    from nds_tpu.sql.planner import ExecError
+    s = replay_session
+    bad = "select k, (select sk from dim where sk < 5) x from f where k = 1"
+    for _ in range(3):                 # eager, record, (blacklisted) eager
+        with pytest.raises(ExecError, match="more than one row"):
+            s.sql(bad).collect()
+
+
 def test_replay_off_by_default_on_cpu(rng, monkeypatch):
     monkeypatch.setenv("NDS_TPU_REPLAY", "auto")
     from nds_tpu.engine.session import Session

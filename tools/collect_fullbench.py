@@ -1,0 +1,59 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Collect a finished nds_bench.py run's cross-phase artifacts into a
+committable FULLBENCH_r{N}/ directory (round-4 verdict item 1: the
+composite metric must be traceable from committed files).
+
+Copies: metrics.csv, the Load Test report, the Power time log, per-stream
+throughput time logs, maintenance time logs/reports, and writes a
+manifest.json with phase wall times and the stream/query counts.
+
+Usage: python tools/collect_fullbench.py <bench_root> <out_dir>
+"""
+
+import csv
+import json
+import os
+import shutil
+import sys
+
+
+def main():
+    root, out = sys.argv[1], sys.argv[2]
+    os.makedirs(out, exist_ok=True)
+    copied = []
+
+    def take(src, dst=None):
+        if os.path.exists(src):
+            d = os.path.join(out, dst or os.path.basename(src))
+            shutil.copy(src, d)
+            copied.append(os.path.basename(d))
+            return True
+        return False
+
+    take(os.path.join(root, "metrics.csv"))
+    take(os.path.join(root, "load_test.txt"))
+    take(os.path.join(root, "power_test.csv"))
+    for name in sorted(os.listdir(root)):
+        if name.startswith(("throughput_report", "maintenance_report")):
+            take(os.path.join(root, name))
+    manifest = {"source_root": root, "files": copied}
+    metrics = os.path.join(root, "metrics.csv")
+    if os.path.exists(metrics):
+        with open(metrics) as f:
+            manifest["metrics"] = dict(
+                row[:2] for row in csv.reader(f) if len(row) >= 2)
+    streams = os.path.join(root, "streams")
+    if os.path.isdir(streams):
+        manifest["stream_files"] = sorted(os.listdir(streams))
+        q0 = os.path.join(streams, "query_0.sql")
+        if os.path.exists(q0):
+            with open(q0) as f:
+                manifest["power_stream_queries"] = sum(
+                    1 for ln in f if ln.startswith("-- start query"))
+    json.dump(manifest, open(os.path.join(out, "manifest.json"), "w"),
+              indent=1)
+    print(f"collected {len(copied)} files -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -249,11 +249,18 @@ def execute_oracle(con, sql, timeout_s=None):
                 tname = stmt.split(":", 1)[1]
                 cols = [r[1] for r in con.execute(
                     f'PRAGMA table_info("{tname}")')]
+                n_rows = con.execute(
+                    f'select count(*) from "{tname}"').fetchone()[0]
                 for c in cols:
-                    if c.endswith("_sk") or c == "item_sk":
+                    # surrogate keys always; for small CTE temps (q64's
+                    # cross_sales self-join on item_sk+store_name+
+                    # store_zip) every column — the indexes cost less
+                    # than one nested-loop pass without them
+                    if c.endswith("_sk") or n_rows <= 200_000:
                         con.execute(
                             f'create index if not exists '
                             f'"ix_tmp_{tname}_{c}" on "{tname}"("{c}")')
+                con.execute(f'analyze "{tname}"')
                 continue
             if stmt.startswith("create temp table "):
                 temp_tables.append(stmt.split()[3])

@@ -62,15 +62,28 @@ def _gkey(e) -> str:
     return expr_key(e)
 
 
+_COL_TABLE: dict | None = None
+
+
+def _schema_column_map() -> dict:
+    """column name -> owning table, built once (the TPC-DS schema is
+    static and every unqualified column name is table-unique)."""
+    global _COL_TABLE
+    if _COL_TABLE is None:
+        try:
+            from nds_tpu.schema import get_schemas
+            _COL_TABLE = {
+                fld.name.lower(): tname
+                for tname, fields in get_schemas(use_decimal=True).items()
+                for fld in fields}
+        except Exception:
+            _COL_TABLE = {}
+    return _COL_TABLE
+
+
 class Emitter:
-    def __init__(self, force_order: bool = False):
+    def __init__(self):
         self.synth = 0
-        # emit comma-joined FROM lists as CROSS JOIN: SQLite treats that
-        # as a join-reorder barrier, pinning the template's textual order
-        # (fact first, indexed dimension lookups after) — the escape hatch
-        # for q64-class 19-relation joins where the greedy planner's own
-        # order never terminates
-        self.force_order = force_order
 
     # ------------------------------------------------------------ queries
 
@@ -116,7 +129,8 @@ class Emitter:
             out.append("distinct")
         out.append(", ".join(self.select_item(it) for it in s.items))
         if s.from_ is not None:
-            out.append("from " + self.from_(s.from_))
+            out.append("from " + self.from_(
+                self._connectivity_order(s.from_, s.where)))
         if s.where is not None:
             out.append("where " + self.expr(s.where))
         if s.group_by is not None and s.group_by.exprs:
@@ -298,10 +312,85 @@ class Emitter:
 
     # --------------------------------------------------------------- FROM
 
+    _REORDER_MIN = 8
+
+    def _flatten_comma(self, f):
+        """Flatten a comma-join chain (Join kind=cross, no condition) into
+        its relation list, or None when the FROM is not such a chain."""
+        if isinstance(f, A.Join) and f.kind == "cross" and \
+                f.condition is None:
+            left = self._flatten_comma(f.left)
+            right = self._flatten_comma(f.right)
+            if left is None or right is None:
+                return None
+            return left + right
+        if isinstance(f, (A.TableRef, A.SubqueryRef)):
+            return [f]
+        return None
+
+    def _connectivity_order(self, f, where):
+        """Reorder a wide comma-join so every relation (after the first)
+        has an equi-join key into the already-placed prefix.
+
+        SQLite treats CROSS JOIN as a reorder barrier, and the comma list
+        parses to a cross-join chain, so the TEXTUAL order IS the plan.
+        TPC-DS templates interleave dimensions whose join keys reference
+        relations appearing later (q64: date_dim d2/d3 keyed on customer
+        columns, but placed before customer) — pinned as written, those
+        become full-table SCANs nested inside the fact scan and the join
+        never finishes. Connectivity ordering keeps every lookup indexed.
+        """
+        rels = self._flatten_comma(f)
+        if rels is None or len(rels) < self._REORDER_MIN or where is None:
+            return f
+        col_table = _schema_column_map()
+        if not col_table:
+            return f
+        names = [(r.alias or r.name).lower() if isinstance(r, A.TableRef)
+                 else r.alias.lower() for r in rels]
+        base = {n: (r.name.lower() if isinstance(r, A.TableRef) else None)
+                for n, r in zip(names, rels)}
+
+        def owner(cr):
+            """relation index a column reference belongs to, or None."""
+            if cr.table:
+                t = cr.table.lower()
+                return names.index(t) if t in names else None
+            t = col_table.get(cr.name.lower())
+            if t is None:
+                return None
+            cands = [i for i, n in enumerate(names)
+                     if base[n] == t or n == t]
+            return cands[0] if len(cands) == 1 else None
+
+        def conjuncts(e):
+            if isinstance(e, A.BinaryOp) and e.op.lower() == "and":
+                return conjuncts(e.left) + conjuncts(e.right)
+            return [e]
+
+        edges = []
+        for c in conjuncts(where):
+            if isinstance(c, A.BinaryOp) and c.op == "=" and \
+                    isinstance(c.left, A.ColumnRef) and \
+                    isinstance(c.right, A.ColumnRef):
+                a, b = owner(c.left), owner(c.right)
+                if a is not None and b is not None and a != b:
+                    edges.append((a, b))
+        placed = [0]
+        rest = list(range(1, len(rels)))
+        while rest:
+            nxt = next((i for i in rest
+                        if any((a in placed and b == i) or
+                               (b in placed and a == i)
+                               for a, b in edges)), rest[0])
+            placed.append(nxt)
+            rest.remove(nxt)
+        return [rels[i] for i in placed]
+
     def from_(self, f) -> str:
         if isinstance(f, list):
-            sep = " cross join " if self.force_order else ", "
-            return sep.join(self.from_(x) for x in f)
+            # a connectivity-reordered wide join: pin the (good) order
+            return " cross join ".join(self.from_(x) for x in f)
         if isinstance(f, A.TableRef):
             return f.name + (f" as {f.alias}" if f.alias else "")
         if isinstance(f, A.SubqueryRef):
@@ -468,7 +557,7 @@ def to_sqlite(sql_text: str) -> str:
     return Emitter().query(stmt)
 
 
-def to_sqlite_script(sql_text: str, force_order: bool = False) -> list[str]:
+def to_sqlite_script(sql_text: str) -> list[str]:
     """Like :func:`to_sqlite` but materializes every CTE as an indexed
     TEMP TABLE (dropped/recreated per query). SQLite re-evaluates a
     WITH-clause body at every reference and joins it without indexes —
@@ -479,7 +568,7 @@ def to_sqlite_script(sql_text: str, force_order: bool = False) -> list[str]:
     stmt = parse(sql_text)
     if not isinstance(stmt, A.Query):
         raise EmitError(f"not a query: {type(stmt).__name__}")
-    em = Emitter(force_order=force_order)
+    em = Emitter()
     stmts: list[str] = []
     for name, cq in stmt.ctes:
         stmts.append(f"drop table if exists {name}")
