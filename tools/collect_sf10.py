@@ -1,0 +1,72 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Assemble SF10_r{N}.json from a completed NDS_BENCH_SCALE=10 bench.py
+run (round-3 verdict missing #2: full-scale Power evidence with
+compile-time and streaming-engagement fields).
+
+Usage: python tools/collect_sf10.py <bench_stderr_log> <bench_stdout_json> <out>
+"""
+
+import json
+import re
+import sys
+
+
+def main():
+    log_path, json_path, out_path = sys.argv[1:4]
+    line = re.compile(
+        r"^# (query\S+): warm ([0-9.]+)s timed ([0-9.]+)s syncs (\d+) "
+        r"syncWait (\d+)ms scan ([0-9.]+)GB/s")
+    fail = re.compile(r"^# (query\S+) failed: (.*)")
+    queries, failures = {}, {}
+    with open(log_path) as f:
+        for ln in f:
+            m = line.match(ln)
+            if m:
+                q, warm, timed, syncs, wait, gbps = m.groups()
+                queries[q] = {
+                    "timed_s": float(timed),
+                    "warm_s": float(warm),     # first-sight wall: XLA
+                    # compile + one streamed execution
+                    "hostSyncs": int(syncs),
+                    "syncWaitMs": int(wait),
+                    "scanGBps": float(gbps),
+                }
+                failures.pop(q, None)          # succeeded on retry
+                continue
+            m = fail.match(ln)
+            if m and m.group(1) not in queries:
+                failures[m.group(1)] = m.group(2)[:160]
+    headline = None
+    try:
+        with open(json_path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    headline = json.loads(ln)
+    except OSError:
+        pass
+    doc = {
+        "scale_factor": 10,
+        "device": "single v5-lite chip via remote attachment",
+        "streaming": ("NDS_TPU_STREAM_BYTES=1.5e9: the full SF10 catalog "
+                      "exceeds resident HBM (without streaming, every "
+                      "query fails RESOURCE_EXHAUSTED — verified); fact "
+                      "tables stream host->device in fixed-power-of-two "
+                      "row chunks through the normal join graph"),
+        "peak_hbm": ("allocator stats unavailable through this remote "
+                     "attachment (memory_stats() returns None); on local "
+                     "chips nds_power.py records hbmBytesInUse/"
+                     "peakHbmRaisedBy per query"),
+        "n_measured": len(queries),
+        "n_failed": len(failures),
+        "headline": headline,
+        "queries": queries,
+        "failures": failures,
+    }
+    json.dump(doc, open(out_path, "w"), indent=1)
+    print(f"wrote {out_path}: {len(queries)} measured, "
+          f"{len(failures)} failed")
+
+
+if __name__ == "__main__":
+    main()
