@@ -1,0 +1,59 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Artifact-claims guard: every benchmark artifact cited in the docs must
+exist as a git-tracked file (round-4 verdict weak #1 — three consecutive
+rounds of doc rot, culminating in README citing a file that was never
+produced; this gate makes the claims ledger mechanically checkable).
+"""
+
+import os
+import re
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = ["README.md", "DESIGN.md", "PERF.md", "SURVEY.md"]
+
+# Citation shapes that name concrete benchmark artifacts:
+#   BENCH_r03.json  SF10_r05.json  ORACLE_r04.txt  LOAD_SF10_r03.txt
+#   REPLAY_SWEEP_r05.txt  FULLBENCH_r04/metrics.csv  FULLBENCH_SF10_r05/
+#   .bench_cache/anything  (scratch — must be promoted before citation)
+ARTIFACT = re.compile(
+    r"(?:\.bench_cache/[\w./-]+"
+    r"|FULLBENCH_[A-Za-z0-9_]+(?:/[\w.-]+)?"
+    r"|\b[A-Z][A-Z0-9_]*_r\d{2}(?:_[\w-]+)?\.(?:json|txt|csv)\b)")
+
+
+def _tracked():
+    out = subprocess.run(
+        ["git", "ls-files"], cwd=REPO, capture_output=True, text=True,
+        check=True).stdout
+    return set(out.splitlines())
+
+
+def _citations(doc):
+    path = os.path.join(REPO, doc)
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        text = f.read()
+    for m in ARTIFACT.finditer(text):
+        yield m.group(0).rstrip("/.")
+
+
+@pytest.mark.parametrize("doc", DOCS)
+def test_cited_artifacts_are_committed(doc):
+    tracked = _tracked()
+    tracked_dirs = {os.path.dirname(p) for p in tracked}
+    missing = []
+    for cite in _citations(doc):
+        if cite.startswith(".bench_cache/"):
+            # scratch dir is never committed; citing it is doc rot by
+            # construction — artifacts must be promoted to the repo root.
+            missing.append(cite + "  (scratch path cited in docs)")
+            continue
+        if cite in tracked or cite in tracked_dirs:
+            continue
+        missing.append(cite)
+    assert not missing, (
+        f"{doc} cites artifacts that are not git-tracked: {missing}")
