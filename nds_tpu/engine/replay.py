@@ -329,7 +329,8 @@ class CompiledQuery:
         # literal outputs carry raw trace-time scalars (TypedInt); jit
         # would have returned arrays, so the chained path must too
         return tuple(
-            jnp.asarray(v.val) if isinstance(v, jex_core.Literal)
+            jnp.asarray(v.val, dtype=v.aval.dtype)
+            if isinstance(v, jex_core.Literal)
             else env[v] for v in self.seg_outsrc)
 
     def run(self, block: bool = False) -> DeviceTable:

@@ -47,7 +47,10 @@ def _w_bytes(buf: io.BytesIO, b: bytes) -> None:
 def _r_long(buf) -> int:
     shift, acc = 0, 0
     while True:
-        b = buf.read(1)[0]
+        byte = buf.read(1)
+        if not byte:
+            raise ValueError("truncated avro file: unexpected EOF in varint")
+        b = byte[0]
         acc |= (b & 0x7F) << shift
         if not b & 0x80:
             break
@@ -168,8 +171,21 @@ def _decoder(t):
 
 def write_avro(table: pa.Table, path: str, compression: str | None = None,
                name: str | None = None) -> None:
-    """Write an arrow table as one Avro Object Container File."""
-    codec = "deflate" if compression in ("deflate", "zlib") else "null"
+    """Write an arrow table as one Avro Object Container File.
+
+    Row-at-a-time pure-python codec: functional-only by design — avro is
+    for format coverage and round-trip validation, not the timed Load
+    Test path (use parquet/orc there; this encoder is orders of magnitude
+    slower on SF>=1 fact tables).
+    """
+    if compression in ("deflate", "zlib"):
+        codec = "deflate"
+    elif compression in (None, "none", "null", "uncompressed"):
+        codec = "null"
+    else:
+        raise ValueError(
+            f"unsupported avro codec {compression!r}: this writer "
+            "implements deflate and null only")
     sync = os.urandom(16)
     encoders = [_encoder(f.type) for f in table.schema]
     cols = [table.column(i).to_pylist() for i in range(table.num_columns)]

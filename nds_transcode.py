@@ -150,6 +150,13 @@ if __name__ == "__main__":
                         help="skip tables whose raw data is absent")
     args = parser.parse_args()
 
+    if args.output_format == "avro" and args.compression not in (
+            None, "none", "null", "uncompressed", "deflate", "zlib"):
+        # fail before any table is written: the avro writer implements
+        # deflate/null only and would otherwise raise mid-transcode
+        parser.error(f"avro supports deflate/null compression, "
+                     f"not {args.compression!r}")
+
     if args.output_mode == "errorifexists" and os.path.exists(args.output_prefix) \
             and os.listdir(args.output_prefix):
         print(f"output {args.output_prefix} exists and is not empty", file=sys.stderr)
