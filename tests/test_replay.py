@@ -137,3 +137,30 @@ def test_replay_off_by_default_on_cpu(rng, monkeypatch):
     for _ in range(3):
         s.sql("select sum(x) from t").collect()
     assert not s._replay_cache
+
+
+def test_hybrid_auto_records_only_high_sync_queries(replay_session,
+                                                    monkeypatch):
+    """'auto' mode (round-4 verdict #4): a query records a replay program
+    only when its first-sight eager run exceeded the host-sync threshold;
+    below it, the query stays eager forever."""
+    monkeypatch.setenv("NDS_TPU_REPLAY", "auto")
+    s = replay_session
+    # threshold above anything Q counts: never records
+    monkeypatch.setenv("NDS_TPU_REPLAY_SYNC_THR", "10000")
+    r1 = s.sql(Q).collect()
+    s.sql(Q).collect()
+    s.sql(Q).collect()
+    assert not s._replay_cache, "low-sync query must stay eager under auto"
+    key = (Q, s._data_version)
+    assert key in s._replay_syncs
+    # threshold 0: any synching query qualifies on its 2nd sight
+    monkeypatch.setenv("NDS_TPU_REPLAY_SYNC_THR", "0")
+    assert s._replay_syncs[key] > 0, "Q should count at least one sync"
+    assert s.replay_pending(Q)
+    r2 = s.sql(Q).collect()           # record + compile
+    assert s._replay_cache, "high-sync query must record under auto"
+    assert s.replay_pending(Q)        # first trace still pending
+    r3 = s.sql(Q).collect()           # first replay (traces)
+    assert not s.replay_pending(Q)
+    assert r1 == r2 == r3 and r1
