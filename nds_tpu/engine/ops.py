@@ -96,6 +96,36 @@ def fetch_bytes() -> int:
     return getattr(_sync_tls, "fetch_bytes", 0)
 
 
+# compile-time accounting: XLA compilation is the dominant first-sight cost
+# at scale (SF1 Power: 70% of the official wall was shape-universe compile)
+# and the reports must split it from execution to be optimizable. JAX's
+# monitoring stream reports every backend compile synchronously on the
+# compiling thread, so thread-local accumulation composes with concurrent
+# Throughput streams exactly like the sync counters above.
+_compile_meter_on = False
+
+
+def _compile_event(event: str, secs: float, **kw) -> None:
+    if event == "/jax/core/compile/backend_compile_duration":
+        _sync_tls.compile_ns = (getattr(_sync_tls, "compile_ns", 0)
+                                + int(secs * 1e9))
+
+
+def enable_compile_meter() -> None:
+    """Register the global compile-duration listener (idempotent)."""
+    global _compile_meter_on
+    if _compile_meter_on:
+        return
+    from jax import monitoring
+    monitoring.register_event_duration_secs_listener(_compile_event)
+    _compile_meter_on = True
+
+
+def compile_ns() -> int:
+    """Nanoseconds of XLA backend compilation on the calling thread."""
+    return getattr(_sync_tls, "compile_ns", 0)
+
+
 # --------------------------------------------------------------------------
 # trace-replay: every host read the engine performs routes through
 # host_read(), so a query can be RECORDED once (eager run, log of host

@@ -102,6 +102,24 @@ class TestResolveBaseline:
         assert abs(vs2 - 2.0) < 1e-9
 
 
+def test_load_resume_prepopulates_and_skips(tmp_path):
+    """A results JSONL from an interrupted campaign must pre-load times
+    and perf (at-scale runs are resumable; round-4 SF10 lost 30 measured
+    queries to a budget kill)."""
+    p = tmp_path / "results.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"name": "query3", "ms": 1234.5,
+                            "hostSyncs": 2, "warmS": 9.8,
+                            "compileS": 7.7}) + "\n")
+        f.write("not json\n")                        # tolerated garbage
+        f.write(json.dumps({"name": "query9", "error": "boom"}) + "\n")
+    times, perf = {}, {}
+    bench.load_resume(str(p), times, perf)
+    assert times == {"query3": 1234.5}
+    assert perf["query3"]["compileS"] == 7.7
+    assert "query9" not in times                     # errors not resumed
+
+
 def test_bench_queries_names_match_stream_names():
     queries = bench.bench_queries()
     names = [n for n, _ in queries]
