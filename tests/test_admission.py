@@ -1,0 +1,122 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Device admission control (parallel/admission.py): the concurrentGpuTasks
+analog must bound in-flight executions across independent acquirers, free
+slots on release, and never leak capacity when a holder dies (flock drops
+with the process)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_slots_bound_concurrency(tmp_path):
+    from nds_tpu.parallel.admission import DeviceAdmission
+    a = DeviceAdmission(2, str(tmp_path))
+    b = DeviceAdmission(2, str(tmp_path))
+    c = DeviceAdmission(2, str(tmp_path))
+    assert a.try_acquire() and b.try_acquire()
+    assert not c.try_acquire(), "third acquirer must queue behind 2 slots"
+    b.release()
+    assert c.try_acquire(), "released slot must be reusable"
+    for x in (a, b, c):
+        x.close()
+
+
+def test_acquire_blocks_and_reports_queue_time(tmp_path):
+    from nds_tpu.parallel.admission import DeviceAdmission
+    a = DeviceAdmission(1, str(tmp_path))
+    b = DeviceAdmission(1, str(tmp_path))
+    assert a.try_acquire()
+    import threading
+    release_at = time.perf_counter() + 0.3
+    threading.Timer(0.3, a.release).start()
+    queued = b.acquire()
+    assert time.perf_counter() >= release_at - 0.05
+    assert queued >= 0.2
+    b.close()
+    a.close()
+
+
+def test_crashed_holder_frees_slot(tmp_path):
+    """A process killed mid-hold must not leak the slot."""
+    child = subprocess.Popen(
+        [sys.executable, "-c", f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+from nds_tpu.parallel.admission import DeviceAdmission
+a = DeviceAdmission(1, {str(tmp_path)!r})
+assert a.try_acquire()
+print("held", flush=True)
+time.sleep(60)
+"""], stdout=subprocess.PIPE, text=True)
+    assert child.stdout.readline().strip() == "held"
+    from nds_tpu.parallel.admission import DeviceAdmission
+    mine = DeviceAdmission(1, str(tmp_path))
+    assert not mine.try_acquire(), "slot should be held by the child"
+    child.kill()
+    child.wait()
+    deadline = time.perf_counter() + 5
+    ok = False
+    while time.perf_counter() < deadline:
+        if mine.try_acquire():
+            ok = True
+            break
+        time.sleep(0.05)
+    assert ok, "kernel must drop a dead holder's flock"
+    mine.close()
+
+
+def test_from_env(monkeypatch, tmp_path):
+    from nds_tpu.parallel import admission
+    monkeypatch.delenv("NDS_TPU_CONCURRENT_QUERIES", raising=False)
+    assert admission.from_env() is None
+    monkeypatch.setenv("NDS_TPU_CONCURRENT_QUERIES", "0")
+    assert admission.from_env() is None
+    monkeypatch.setenv("NDS_TPU_CONCURRENT_QUERIES", "3")
+    monkeypatch.setenv("NDS_TPU_ADMISSION_DIR", str(tmp_path))
+    a = admission.from_env()
+    assert a is not None and a.slots == 3 and a.dir == str(tmp_path)
+    with a.slot() as queued:
+        assert queued == 0.0 or queued >= 0.0
+    a.close()
+
+
+def test_power_records_admission_fields(tmp_path, monkeypatch):
+    """nds_power wires the knob: summaries must carry the queued time and
+    slot count when the env knob is set (SURVEY §2.4.5)."""
+    pytest.importorskip("pyarrow")
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from collections import OrderedDict
+
+    from nds_tpu import power
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.types import to_arrow as to_pa
+    fields = get_schemas(use_decimal=True)["item"]
+    monkeypatch.setattr(power, "get_schemas",
+                        lambda use_decimal: {"item": fields})
+    data = tmp_path / "data"
+    (data / "item").mkdir(parents=True)
+    cols = {f.name: pa.array([None, None, None], to_pa(f.type))
+            for f in fields}
+    cols["i_item_sk"] = pa.array([1, 2, 3], to_pa(fields[0].type))
+    pq.write_table(pa.table(cols), data / "item" / "part-0.parquet")
+    monkeypatch.setenv("NDS_TPU_CONCURRENT_QUERIES", "1")
+    monkeypatch.setenv("NDS_TPU_ADMISSION_DIR", str(tmp_path / "slots"))
+    out = tmp_path / "json"
+    power.run_query_stream(str(data), None,
+                           OrderedDict(q="select count(*) cnt from item"),
+                           str(tmp_path / "time.csv"),
+                           json_summary_folder=str(out))
+    import glob
+    import json as J
+    js = glob.glob(str(out / "*.json"))
+    assert js
+    doc = J.load(open(js[0]))
+    assert doc.get("concurrentQueries") == 1
+    assert "admissionQueuedMs" in doc
