@@ -1,0 +1,34 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Seeded-row oracle parity (tools/oracle_seeded.py): the corpus queries
+that are natural-empty at CI scales must pass NON-EMPTY cross-engine
+parity on constructed rows — a zero-row pass exercises predicates only
+(round-4 verdict #8). CI gates a fast subset; the full 7 run in the
+committed sweep artifact."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+@pytest.fixture(scope="module")
+def stream_queries():
+    from nds_tpu.power import gen_sql_from_stream
+    from nds_tpu.queries import generate_query_streams
+    d = os.path.join(REPO, ".bench_cache", "oracle_stream")
+    f = os.path.join(d, "query_0.sql")
+    if not os.path.exists(f):
+        os.makedirs(d, exist_ok=True)
+        generate_query_streams(d, streams=1, rngseed=19620718, scale=0.01)
+    return gen_sql_from_stream(f)
+
+
+@pytest.mark.parametrize("q", ["query8", "query34", "query53"])
+def test_seeded_nonempty_parity(stream_queries, q):
+    from tools.oracle_seeded import run_seeded
+    n, why = run_seeded(q, stream_queries[q])
+    assert why is None, why
+    assert n > 0

@@ -1,0 +1,271 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Seeded-row oracle parity for the vacuous queries.
+
+Seven corpus queries return zero rows at every tested (seed, scale) —
+proven natural-empty by tools/oracle_seed_hunt.py across 16 seeds x 3
+scales — so their oracle PASS exercised predicates only, never the
+aggregation/having/join semantics (round-4 verdict weak #5 / next #8).
+This tool closes that: for each such query it synthesizes a micro-catalog
+whose rows are CONSTRUCTED to satisfy the query's predicate/HAVING/volume
+constraints (parameters parsed from the generated SQL itself), loads the
+identical rows into BOTH engines (the TPU engine and stdlib SQLite), and
+requires non-empty, row-for-row identical results.
+
+The reference's validation compares real result rows between engines
+(ref: nds/nds_validate.py:48-114); injected fixtures extend that to
+queries whose predicates are unsatisfiable at CI scales.
+
+Usage: python tools/oracle_seeded.py [--queries q8,...]
+"""
+
+import argparse
+import datetime
+import os
+import re
+import sqlite3
+import sys
+from decimal import Decimal
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# a site hook may register an external TPU plugin at interpreter start and
+# override jax_platforms; re-pin after import (same as tests/conftest.py)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+VACUOUS = ["query8", "query23_part2", "query24_part1", "query24_part2",
+           "query34", "query53", "query63"]
+
+
+def _first(pattern, sql, cast=str):
+    m = re.search(pattern, sql, re.IGNORECASE)
+    if not m:
+        raise ValueError(f"parameter {pattern!r} not found in query text")
+    return cast(m.group(1))
+
+
+def _quoted_list(pattern, sql):
+    m = re.search(pattern, sql, re.IGNORECASE | re.DOTALL)
+    if not m:
+        raise ValueError(f"list {pattern!r} not found in query text")
+    return re.findall(r"'([^']*)'", m.group(1))
+
+
+def seed_rows(qname: str, sql: str):
+    """Per-query micro-catalog: {table: [row dicts]} satisfying the
+    query's parsed parameters. Every row set is minimal but sufficient
+    for a non-empty result."""
+    if qname == "query8":
+        qoy = _first(r"d_qoy\s*=\s*(\d)", sql, int)
+        year = _first(r"d_year\s*=\s*(\d+)", sql, int)
+        zip5 = _first(r"in\s*\(\s*'(\d{5})'", sql)
+        rows = {
+            # 11 preferred customers in one listed zip: the inner
+            # having count(*) > 10 gate
+            "customer_address": [
+                {"ca_address_sk": i, "ca_zip": zip5 + "0000"}
+                for i in range(1, 12)],
+            "customer": [
+                {"c_customer_sk": i, "c_current_addr_sk": i,
+                 "c_preferred_cust_flag": "Y"} for i in range(1, 12)],
+            "date_dim": [{"d_date_sk": 1, "d_qoy": qoy, "d_year": year}],
+            # store zip shares the 2-char prefix the join key uses
+            "store": [{"s_store_sk": 1, "s_store_name": "ese",
+                       "s_zip": zip5}],
+            "store_sales": [{"ss_store_sk": 1, "ss_sold_date_sk": 1,
+                             "ss_net_profit": 11.5}],
+        }
+        return rows
+    if qname == "query34":
+        year = _first(r"d_year in \((\d+)", sql, int)
+        pots = re.findall(r"hd_buy_potential = '([^']+)'", sql)
+        county = _quoted_list(r"s_county in \(([^)]+)\)", sql)[0]
+        return {
+            "date_dim": [{"d_date_sk": 1, "d_dom": 1, "d_year": year}],
+            "household_demographics": [
+                # dep/vehicle = 3/2 = 1.5 > 1.2 ratio gate
+                {"hd_demo_sk": 1, "hd_buy_potential": pots[0],
+                 "hd_vehicle_count": 2, "hd_dep_count": 3}],
+            "store": [{"s_store_sk": 1, "s_county": county}],
+            "customer": [{"c_customer_sk": 1, "c_last_name": "Seed",
+                          "c_first_name": "Row", "c_salutation": "Dr.",
+                          "c_preferred_cust_flag": "Y"}],
+            # one ticket with 16 line items: cnt between 15 and 20
+            "store_sales": [
+                {"ss_ticket_number": 7, "ss_customer_sk": 1,
+                 "ss_sold_date_sk": 1, "ss_store_sk": 1, "ss_hdemo_sk": 1,
+                 "ss_item_sk": i} for i in range(1, 17)],
+        }
+    if qname in ("query53", "query63"):
+        mseq = _first(r"d_month_seq in \((\d+)", sql, int)
+        cats = _quoted_list(r"i_category in \(([^)]+)\)", sql)
+        classes = _quoted_list(r"i_class in \(([^)]+)\)", sql)
+        brands = _quoted_list(r"i_brand in \(([^)]+)\)", sql)
+        item = {"i_item_sk": 1, "i_category": cats[0],
+                "i_class": classes[0], "i_brand": brands[0],
+                "i_manufact_id": 5, "i_manager_id": 5}
+        return {
+            "item": [item],
+            # two periods in the window with a 10x sales skew: the
+            # |sum - avg| / avg > 0.1 deviation gate holds in both
+            "date_dim": [
+                {"d_date_sk": 1, "d_month_seq": mseq, "d_qoy": 1,
+                 "d_moy": 1},
+                {"d_date_sk": 2, "d_month_seq": mseq + 3, "d_qoy": 2,
+                 "d_moy": 4}],
+            "store": [{"s_store_sk": 1}],
+            "store_sales": [
+                {"ss_item_sk": 1, "ss_sold_date_sk": 1, "ss_store_sk": 1,
+                 "ss_sales_price": 100.0},
+                {"ss_item_sk": 1, "ss_sold_date_sk": 2, "ss_store_sk": 1,
+                 "ss_sales_price": 10.0}],
+        }
+    if qname in ("query24_part1", "query24_part2"):
+        color = _first(r"i_color = '(\w+)'", sql)
+        market = _first(r"s_market_id = (\d+)", sql, int)
+        return {
+            "store": [{"s_store_sk": 1, "s_market_id": market,
+                       "s_store_name": "ese", "s_state": "TN",
+                       "s_zip": "12345"}],
+            "customer_address": [
+                {"ca_address_sk": 1, "ca_zip": "12345", "ca_state": "TN",
+                 "ca_country": "United States"}],
+            # birth country must differ from upper(ca_country)
+            "customer": [{"c_customer_sk": 1, "c_birth_country": "GERMANY",
+                          "c_current_addr_sk": 1, "c_last_name": "Seed",
+                          "c_first_name": "Row"}],
+            "item": [{"i_item_sk": 1, "i_color": color,
+                      "i_current_price": 1.25, "i_manager_id": 1,
+                      "i_units": "Ounce", "i_size": "small"}],
+            "store_sales": [
+                {"ss_ticket_number": 1, "ss_item_sk": 1,
+                 "ss_customer_sk": 1, "ss_store_sk": 1,
+                 "ss_net_paid": 50.0}],
+            # the sale must have a matching return (ticket+item join)
+            "store_returns": [{"sr_ticket_number": 1, "sr_item_sk": 1}],
+        }
+    if qname == "query23_part2":
+        y0 = _first(r"d_year in \((\d+)", sql, int)
+        year = _first(r"d_year = (\d+)", sql, int)
+        moy = _first(r"d_moy = (\d+)", sql, int)
+        d = datetime.date(year, moy, 1)
+        return {
+            "item": [{"i_item_sk": 1, "i_item_desc": "seeded frequent"}],
+            "date_dim": [{"d_date_sk": 1, "d_year": max(y0, year),
+                          "d_moy": moy, "d_date": d}],
+            "customer": [{"c_customer_sk": 1, "c_last_name": "Seed",
+                          "c_first_name": "Row"}],
+            # 5 same-item same-day sales: count(*) > 4 'frequent' gate;
+            # the single customer's total IS the max: > 50% of max holds
+            "store_sales": [
+                {"ss_item_sk": 1, "ss_sold_date_sk": 1,
+                 "ss_customer_sk": 1, "ss_quantity": 1,
+                 "ss_sales_price": 10.0} for _ in range(5)],
+            "catalog_sales": [
+                {"cs_sold_date_sk": 1, "cs_item_sk": 1,
+                 "cs_bill_customer_sk": 1, "cs_quantity": 2,
+                 "cs_list_price": 30.0}],
+            "web_sales": [],
+        }
+    raise ValueError(f"no seed recipe for {qname}")
+
+
+def build_engines(rows_by_table):
+    """Load identical rows into a fresh engine session and SQLite."""
+    import pyarrow as pa
+
+    from nds_tpu.engine.session import Session
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.types import to_arrow as to_pa
+    from tools.oracle_validate import _sqlite_type
+
+    schemas = get_schemas(use_decimal=True)
+    sess = Session()
+    con = sqlite3.connect(":memory:")
+    for tname, rows in rows_by_table.items():
+        fields = schemas[tname]
+        arrays = {}
+        for f in fields:
+            vals = [r.get(f.name) for r in rows]
+            if f.type.startswith("decimal"):
+                vals = [None if v is None else Decimal(str(v))
+                        for v in vals]
+            arrays[f.name] = pa.array(vals, to_pa(f.type))
+        sess.create_temp_view(tname, pa.table(arrays), base=True)
+        cols = ", ".join(f'"{f.name}" {_sqlite_type(f.type)}'
+                         for f in fields)
+        con.execute(f'CREATE TABLE "{tname}" ({cols})')
+        ph = ", ".join("?" * len(fields))
+        svals = []
+        for r in rows:
+            out = []
+            for f in fields:
+                v = r.get(f.name)
+                if isinstance(v, datetime.date):
+                    v = v.isoformat()
+                elif isinstance(v, float) and f.type.startswith("decimal"):
+                    v = float(Decimal(str(v)))
+                out.append(v)
+            svals.append(out)
+        if svals:
+            con.executemany(f'INSERT INTO "{tname}" VALUES ({ph})', svals)
+    con.commit()
+    return sess, con
+
+
+def run_seeded(qname: str, sql: str):
+    """Returns (n_rows, why_or_None). Non-empty identical rows = pass."""
+    from tools.oracle_validate import (engine_date_to_text, execute_oracle,
+                                       rows_match)
+    rows_by_table = seed_rows(qname, sql)
+    sess, con = build_engines(rows_by_table)
+    oracle_rows = execute_oracle(con, sql)
+    engine_rows = engine_date_to_text(sess.sql(sql).collect(), None)
+    ok, why = rows_match(engine_rows, oracle_rows)
+    if not ok:
+        return len(engine_rows), why
+    if not engine_rows:
+        return 0, "seeded rows still produced an empty result"
+    return len(engine_rows), None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--queries", help="comma list; default = the 7 vacuous")
+    args = ap.parse_args()
+    from nds_tpu.power import gen_sql_from_stream
+    stream = os.path.join(REPO, ".bench_cache", "oracle_stream",
+                          "query_0.sql")
+    if not os.path.exists(stream):
+        from nds_tpu.queries import generate_query_streams
+        os.makedirs(os.path.dirname(stream), exist_ok=True)
+        generate_query_streams(os.path.dirname(stream), streams=1,
+                               rngseed=19620718,
+                               scale=float(os.environ.get(
+                                   "NDS_ORACLE_SCALE", "0.01")))
+    queries = gen_sql_from_stream(stream)
+    want = ([q.strip() for q in args.queries.split(",")]
+            if args.queries else VACUOUS)
+    failed = []
+    for q in want:
+        try:
+            n, why = run_seeded(q, queries[q])
+        except Exception as e:
+            failed.append(q)
+            print(f"FAIL {q:16s} {type(e).__name__}: {e}", flush=True)
+            continue
+        if why:
+            failed.append(q)
+            print(f"FAIL {q:16s} {why[:120]}", flush=True)
+        else:
+            print(f"PASS {q:16s} rows={n} (seeded)", flush=True)
+    print(f"\n=== seeded oracle: {len(want) - len(failed)}/{len(want)} "
+          "non-empty parity ===")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
