@@ -177,6 +177,22 @@ def power_test(input_path, stream_path, report_path, property_path,
     subprocess.run(cmd, check=True)
 
 
+def warm_test(input_path, stream_path, report_path, property_path,
+              warehouse_type, device):
+    """Optional precompile phase (off by default): one untimed pass of the
+    Power stream to fill the persistent XLA compile cache, so TPower
+    measures execution rather than shape-universe compilation — the
+    warmed-JVM analog. Its report carries Warm markers, never Power."""
+    cmd = [PY, os.path.join(REPO, "nds_power.py"), input_path, stream_path,
+           report_path, "--input_format", warehouse_type, "--device", device,
+           "--warm", "--allow_failure"]
+    if property_path:
+        cmd += ["--property_file", property_path]
+    # best-effort by design: a transient failure while cache-filling must
+    # not abort the official phases that follow
+    subprocess.run(cmd, check=False)
+
+
 def throughput_test(num_streams, first_or_second, input_path,
                     stream_base_path, report_base_path, property_path,
                     warehouse_type, device):
@@ -265,6 +281,12 @@ def run_full_bench(yaml_params):
         RNGSEED = get_load_end_timestamp(load_report_path)
         gen_streams(num_streams, query_template_dir, scale_factor,
                     stream_output_path, RNGSEED)
+    # 2.5: optional precompile (absent/skip=true by default)
+    wt = yaml_params.get('warm_test') or {}
+    if not wt.get('skip', True):
+        warm_test(warehouse_output_path, power_stream_path,
+                  wt.get('report_path') or power_report_path + '.warm',
+                  power_property_path, warehouse_type, device)
     # 3.
     if not pt['skip']:
         power_test(warehouse_output_path, power_stream_path,

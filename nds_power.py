@@ -69,6 +69,13 @@ if __name__ == "__main__":
     parser.add_argument("--profile",
                         help="folder for per-query device profiler traces "
                         "(XProf/TensorBoard dumps).")
+    parser.add_argument("--warm",
+                        action="store_true",
+                        help="precompile pass: execute the stream once to "
+                        "populate the persistent XLA compile cache (the "
+                        "warmed-JVM analog); the time log is written with "
+                        "Warm markers so it can never be mistaken for an "
+                        "official Power Run.")
     args = parser.parse_args()
 
     if args.device == "cpu":
@@ -91,4 +98,5 @@ if __name__ == "__main__":
                      args.output_format,
                      args.json_summary_folder,
                      args.allow_failure,
-                     profile_folder=args.profile)
+                     profile_folder=args.profile,
+                     warm=args.warm)

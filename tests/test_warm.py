@@ -1,0 +1,34 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Warm (precompile) pass: one untimed stream execution that fills the
+persistent compile cache; its time log must carry Warm markers and never
+the Power markers the metrics collectors key on (round-4 verdict #3)."""
+
+import csv
+import os
+from collections import OrderedDict
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+def test_warm_run_writes_warm_markers(tmp_path, monkeypatch):
+    from nds_tpu import power
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.types import to_arrow as to_pa
+    fields = get_schemas(use_decimal=True)["item"]
+    monkeypatch.setattr(power, "get_schemas",
+                        lambda use_decimal: {"item": fields})
+    data = tmp_path / "data"
+    (data / "item").mkdir(parents=True)
+    cols = {f.name: pa.array([None, None], to_pa(f.type)) for f in fields}
+    cols["i_item_sk"] = pa.array([1, 2], to_pa(fields[0].type))
+    pq.write_table(pa.table(cols), data / "item" / "part-0.parquet")
+    log = tmp_path / "warm.csv"
+    power.run_query_stream(str(data), None,
+                           OrderedDict(q="select count(*) c from item"),
+                           str(log), warm=True)
+    rows = list(csv.reader(open(log)))
+    names = [r[1] for r in rows]
+    assert "Warm Test Time" in names and "Warm Start Time" in names
+    assert not any(n.startswith("Power") for n in names), \
+        "a warm report must never be parseable as a Power Run"
