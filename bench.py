@@ -337,11 +337,13 @@ def write_perf(times, perf):
                 f"Aggregate: {len(times)} queries, "
                 f"{tot_sync / max(tot_ms, 1e-9) * 100:.1f}% of summed wall "
                 "time blocked on device->host reads.\n\n")
-        f.write("| query | wall ms | warm s | host syncs | sync wait ms | "
-                "scan MB | scan GB/s |\n|---|---|---|---|---|---|---|\n")
+        f.write("| query | wall ms | warm s | compile s | host syncs | "
+                "sync wait ms | scan MB | scan GB/s |\n"
+                "|---|---|---|---|---|---|---|---|\n")
         for q in rows:
             p = perf.get(q, {})
             f.write(f"| {q} | {times[q]:.0f} | {p.get('warmS', '-')} | "
+                    f"{p.get('compileS', '-')} | "
                     f"{p.get('hostSyncs', '-')} | "
                     f"{p.get('syncWaitMs', '-')} | "
                     f"{p.get('scanBytes', 0) / 1e6:.1f} | "
