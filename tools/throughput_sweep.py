@@ -1,0 +1,135 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Stream-concurrency scaling sweep (round-4 verdict #9).
+
+Runs the Throughput Test at 1/2/4/8 concurrent streams (and optionally a
+set of admission-slot values at the widest point) against one dataset,
+and assembles THROUGHPUT_r{N}.json with spec Ttt per configuration —
+turning the device-sharing policy (NDS_TPU_CONCURRENT_QUERIES,
+parallel/admission.py) into a measured decision the way the reference
+tunes concurrentGpuTasks (ref: nds/power_run_gpu.template:34,38).
+
+Usage:
+    python tools/throughput_sweep.py <data_dir> <stream_dir> <out.json>
+        [--streams 1,2,4,8] [--admission 0,1,2]
+        [--sub_queries q1,q2,...] [--input_format parquet]
+
+Streams are taken as query_1.sql .. query_N.sql under stream_dir
+(query_0 is the Power stream by convention).
+"""
+
+import argparse
+import csv
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def stream_bounds(path):
+    start = end = None
+    n = 0
+    with open(path) as f:
+        for row in csv.reader(f):
+            if len(row) < 3 or not row[2].strip().isdigit():
+                continue
+            if row[1] == "Power Start Time":
+                start = int(row[2])
+            elif row[1] == "Power End Time":
+                end = int(row[2])
+            elif row[1].startswith("query"):
+                n += 1
+    return start, end, n
+
+
+def run_config(n_streams, admission, data_dir, stream_dir, work_dir,
+               sub_queries, input_format):
+    streams = ",".join(str(i) for i in range(1, n_streams + 1))
+    base = os.path.join(work_dir, f"s{n_streams}_a{admission}")
+    env = dict(os.environ)
+    if admission:
+        env["NDS_TPU_CONCURRENT_QUERIES"] = str(admission)
+        env["NDS_TPU_ADMISSION_DIR"] = base + "_slots"
+    else:
+        env.pop("NDS_TPU_CONCURRENT_QUERIES", None)
+    cmd = [os.path.join(REPO, "nds-throughput"), streams,
+           PY, os.path.join(REPO, "nds_power.py"), data_dir,
+           os.path.join(stream_dir, "query_{}.sql"), base + "_{}.csv",
+           "--input_format", input_format]
+    if sub_queries:
+        cmd += ["--sub_queries", sub_queries]
+    t0 = time.time()
+    r = subprocess.run(cmd, env=env, capture_output=True, text=True)
+    wall = time.time() - t0
+    info = {"n_streams": n_streams, "admission_slots": admission,
+            "launcher_wall_s": round(wall, 1), "rc": r.returncode,
+            "streams": {}}
+    starts, ends, total_q = [], [], 0
+    for i in range(1, n_streams + 1):
+        p = f"{base}_{i}.csv"
+        if not os.path.exists(p):
+            info["streams"][i] = {"error": "no report"}
+            continue
+        st, en, nq = stream_bounds(p)
+        if st is None:
+            info["streams"][i] = {"error": "missing markers"}
+            continue
+        starts.append(st)
+        ends.append(en)
+        total_q += nq
+        info["streams"][i] = {"wall_s": en - st, "queries": nq}
+    if starts:
+        info["Ttt_s"] = max(ends) - min(starts)
+        info["total_queries"] = total_q
+        # scaling diagnostics: work per second of Ttt, and the serial
+        # fraction implied vs the 1-stream run (filled by the caller)
+        info["queries_per_s"] = round(total_q / max(info["Ttt_s"], 1), 3)
+    if r.returncode != 0:
+        info["stderr_tail"] = r.stderr[-800:]
+    return info
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("data_dir")
+    ap.add_argument("stream_dir")
+    ap.add_argument("out")
+    ap.add_argument("--streams", default="1,2,4,8")
+    ap.add_argument("--admission", default="0",
+                    help="admission slot values to sweep at EACH stream "
+                    "count; 0 = unlimited")
+    ap.add_argument("--sub_queries")
+    ap.add_argument("--input_format", default="parquet")
+    ap.add_argument("--work_dir", default="/tmp/nds_tt_sweep")
+    args = ap.parse_args()
+    os.makedirs(args.work_dir, exist_ok=True)
+
+    configs = []
+    for n in (int(x) for x in args.streams.split(",")):
+        for a in (int(x) for x in args.admission.split(",")):
+            configs.append((n, a))
+    results = []
+    for n, a in configs:
+        print(f"# sweep: {n} streams, admission={a or 'unlimited'}",
+              flush=True)
+        info = run_config(n, a, args.data_dir, args.stream_dir,
+                          args.work_dir, args.sub_queries,
+                          args.input_format)
+        results.append(info)
+        print(json.dumps({k: v for k, v in info.items()
+                          if k != "streams"}), flush=True)
+        json.dump({"note": (
+            "Stream-concurrency scaling on one chip: spec Ttt = "
+            "max(stream end) - min(stream start) per configuration; "
+            "admission_slots is the NDS_TPU_CONCURRENT_QUERIES "
+            "device-sharing knob (0 = unlimited interleaving)."),
+            "sub_queries": args.sub_queries or "full streams",
+            "configs": results}, open(args.out, "w"), indent=1)
+    print(f"# wrote {args.out} ({len(results)} configs)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
