@@ -195,14 +195,29 @@ def run_server():
                   f"syncs {syncs} syncWait {sync_ms:.0f}ms "
                   f"scan {gbps:.2f}GB/s",
                   file=sys.stderr)
-            print(json.dumps({
+            result = {
                 "name": name, "ms": ms, "hostSyncs": syncs,
                 "syncWaitMs": round(sync_ms, 1), "scanBytes": scan,
                 "scanGBps": round(gbps, 3),
                 # warm pass wall = XLA compile (+1 exec): the per-query
                 # compile-cost axis the SF10 scaling question turns on
                 "warmS": round(t0 - tw, 2),
-                "compileS": round(compile_s, 2)}), flush=True)
+                "compileS": round(compile_s, 2)}
+            try:
+                # per-query HBM footprint where the backend exposes
+                # allocator stats (local chips; the tunneled attachment
+                # returns None — recorded so the gap is visible, not
+                # silent)
+                import jax as _jax
+                stats = _jax.devices()[0].memory_stats()
+                if stats:
+                    result["hbmBytesInUse"] = int(
+                        stats.get("bytes_in_use", 0))
+                    result["peakHbmBytes"] = int(
+                        stats.get("peak_bytes_in_use", 0))
+            except Exception:
+                pass
+            print(json.dumps(result), flush=True)
         except Exception as e:                        # keep serving
             print(json.dumps({"name": name,
                               "error": f"{type(e).__name__}: {e}"[:300]}),

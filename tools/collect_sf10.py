@@ -16,7 +16,7 @@ import re
 import sys
 
 KEYS = ("hostSyncs", "syncWaitMs", "scanBytes", "scanGBps", "warmS",
-        "compileS")
+        "compileS", "hbmBytesInUse", "peakHbmBytes")
 
 
 def main():
