@@ -239,9 +239,10 @@ class Session:
         # host-resident and is bound chunk-by-chunk by the planner (the
         # role of Spark's file splits; SURVEY.md §5.7). A meshed session
         # row-shards instead — the mesh multiplies device capacity.
-        limit = int(self.conf.get(
+        # float() first: operators write thresholds like "1.5e9"
+        limit = int(float(self.conf.get(
             "stream_bytes",
-            os.environ.get("NDS_TPU_STREAM_BYTES", str(8 << 30))))
+            os.environ.get("NDS_TPU_STREAM_BYTES", str(8 << 30)))))
         if self.mesh is None and arrow.nbytes > limit:
             self.create_temp_view(
                 name, ChunkedTable(arrow, canonical_types), base=True)
