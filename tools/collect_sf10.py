@@ -9,6 +9,7 @@ weak #1-2: the at-scale artifact must cover all 103 queries and be
 committed, with failures explained.)
 
 Usage: python tools/collect_sf10.py <results_jsonl> <bench_stderr_log> <out>
+           [device_note]
 """
 
 import json
@@ -41,9 +42,11 @@ def main():
                     failures[m.group(1)] = m.group(2)[:160]
     except OSError:
         pass
+    device = (sys.argv[4] if len(sys.argv) > 4
+              else "single v5-lite chip via remote attachment")
     doc = {
         "scale_factor": 10,
-        "device": "single v5-lite chip via remote attachment",
+        "device": device,
         "streaming": ("NDS_TPU_STREAM_BYTES=1.5e9: the full SF10 catalog "
                       "exceeds resident HBM (without streaming, every "
                       "query fails RESOURCE_EXHAUSTED — verified); fact "
