@@ -1,0 +1,218 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Driver lint: hygiene checks for the top-level CLIs and ``tools/``.
+
+The drivers orchestrate multi-hour campaigns as subprocess trees; the
+failure modes that waste a campaign are not kernel bugs but driver bugs:
+an exception swallowed into ``pass``, a template expanded through a shell,
+a report handle never flushed. Rules (suppressible with
+``# nds-lint: ignore[rule]``):
+
+* ``swallowed-exception`` — a bare ``except:`` or ``except Exception:``
+  whose body is only ``pass``: the campaign continues with no record of
+  what was lost. Narrow excepts (``except OSError: pass``) are allowed —
+  they document a decision.
+* ``shell-injection`` — ``os.system``/``os.popen`` with a non-constant
+  command, or ``subprocess.*(..., shell=True)``: template/param expansion
+  through a shell turns a query string into an execution vector.
+* ``unmanaged-file-handle`` — ``open()`` neither used as a context manager
+  nor assigned to a name that is later ``.close()``d in the same scope:
+  on CPython the report usually survives via refcounting, but a crashed
+  driver loses buffered output exactly when the artifact matters.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from nds_tpu.analysis import Finding, suppressed
+
+_BROAD = (None, "Exception", "BaseException")
+
+
+def _exc_name(node) -> str | None:
+    if node is None:
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return "<expr>"
+
+
+class _Audit(ast.NodeVisitor):
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.lines = source.splitlines()
+        self.findings: list = []
+        self.scope_stack = ["<module>"]
+        # open() assignments pending a .close() in the same scope:
+        # scope depth -> {name -> lineno}
+        self.open_assigns: list = [{}]
+        self.closed_names: list = [set()]
+
+    def _emit(self, rule: str, severity: str, message: str,
+              lineno: int) -> None:
+        if suppressed(self.lines, lineno, rule):
+            return
+        self.findings.append(Finding(self.rel, self.scope_stack[-1], rule,
+                                     severity, message, lineno))
+
+    # -- scopes -------------------------------------------------------------
+
+    def visit_FunctionDef(self, node):
+        self.scope_stack.append(node.name)
+        self.open_assigns.append({})
+        self.closed_names.append(set())
+        self.generic_visit(node)
+        self._flush_opens()
+        self.scope_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _flush_opens(self) -> None:
+        opens = self.open_assigns.pop()
+        closed = self.closed_names.pop()
+        for name, lineno in opens.items():
+            if name not in closed:
+                self._emit("unmanaged-file-handle", "warning",
+                           f"open() assigned to {name!r} but never closed "
+                           "in this scope (use a with-statement)", lineno)
+
+    # -- exceptions ---------------------------------------------------------
+
+    def visit_ExceptHandler(self, node):
+        only_pass = all(isinstance(s, ast.Pass) for s in node.body)
+        if only_pass and _exc_name(node.type) in _BROAD:
+            what = _exc_name(node.type) or "bare except"
+            self._emit("swallowed-exception", "warning",
+                       f"{what} swallowed with pass: failures vanish "
+                       "without a log line", node.lineno)
+        self.generic_visit(node)
+
+    # -- calls --------------------------------------------------------------
+
+    def visit_Call(self, node):
+        # shell=True is checked on ANY call spelling — subprocess.run,
+        # sp.run, bare run from `from subprocess import run` — the kwarg
+        # itself is the hazard, not the callee's name
+        for kw in node.keywords:
+            if kw.arg == "shell" and isinstance(
+                    kw.value, ast.Constant) and kw.value.value:
+                self._emit("shell-injection", "error",
+                           "subprocess call with shell=True",
+                           node.lineno)
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            owner = f.value.id if isinstance(f.value, ast.Name) else None
+            if owner == "os" and f.attr in ("system", "popen"):
+                if node.args and not isinstance(node.args[0], ast.Constant):
+                    self._emit("shell-injection", "error",
+                               f"os.{f.attr}() with a computed command "
+                               "string; use subprocess with an argv list",
+                               node.lineno)
+            if f.attr == "close" and isinstance(f.value, ast.Name):
+                self.closed_names[-1].add(f.value.id)
+        self.generic_visit(node)
+
+    # -- open() tracking ----------------------------------------------------
+
+    def _is_open_call(self, node) -> bool:
+        return isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Name) and node.func.id == "open"
+
+    def visit_With(self, node):
+        # open() as a with-item is the managed pattern; don't descend into
+        # the item expressions with the generic open() check
+        for item in node.items:
+            self._mark_with_opens(item.context_expr)
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    def _mark_with_opens(self, expr) -> None:
+        for n in ast.walk(expr):
+            if self._is_open_call(n):
+                n._nds_managed = True  # type: ignore[attr-defined]
+
+    def _track_open_assign(self, tgt, value, lineno: int) -> None:
+        if isinstance(tgt, ast.Name):
+            value._nds_managed = True  # type: ignore[attr-defined]
+            prev = self.open_assigns[-1].get(tgt.id)
+            if prev is not None and tgt.id not in self.closed_names[-1]:
+                # name re-bound to a second open() before the first was
+                # closed: the first handle leaks right here
+                self._emit("unmanaged-file-handle", "warning",
+                           f"open() assigned to {tgt.id!r} is re-bound "
+                           "before being closed (use a with-statement)",
+                           prev)
+            # a close() seen so far covered the PREVIOUS handle; the
+            # new one needs its own
+            self.closed_names[-1].discard(tgt.id)
+            self.open_assigns[-1][tgt.id] = lineno
+        elif isinstance(tgt, (ast.Attribute, ast.Subscript)):
+            # a handle stored on an object (self.f = open(...)) has a
+            # deliberate cross-method lifetime; closing it is the
+            # owner's contract, not an inline leak this lint can see
+            value._nds_managed = True  # type: ignore[attr-defined]
+
+    def visit_Assign(self, node):
+        if self._is_open_call(node.value) and len(node.targets) == 1:
+            self._track_open_assign(node.targets[0], node.value,
+                                    node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        # f: IO = open(p) is the same tracked pattern as f = open(p)
+        if node.value is not None and self._is_open_call(node.value):
+            self._track_open_assign(node.target, node.value, node.lineno)
+        self.generic_visit(node)
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            if self._is_open_call(child) and \
+                    not getattr(child, "_nds_managed", False):
+                self._emit("unmanaged-file-handle", "warning",
+                           "open() result used inline without a "
+                           "with-statement: the handle is never closed "
+                           "deterministically", child.lineno)
+        super().generic_visit(node)
+
+
+def audit_file(path: str, rel: str | None = None) -> list:
+    with open(path) as f:
+        source = f.read()
+    rel = rel or path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rel, "<module>", "syntax-error", "error",
+                        str(e), e.lineno or 0)]
+    audit = _Audit(rel, source)
+    audit.visit(tree)
+    audit._flush_opens()
+    return audit.findings
+
+
+def driver_files(repo_root: str | None = None) -> list:
+    """The driver surface: top-level ``nds_*.py`` + ``bench.py`` CLIs and
+    every script in ``tools/``."""
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    files = sorted(glob.glob(os.path.join(repo_root, "nds_*.py")))
+    files += [p for p in (os.path.join(repo_root, "bench.py"),)
+              if os.path.exists(p)]
+    files += sorted(glob.glob(os.path.join(repo_root, "tools", "*.py")))
+    return files
+
+
+def audit_drivers(repo_root: str | None = None) -> list:
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    findings: list = []
+    for p in driver_files(repo_root):
+        findings.extend(audit_file(p, os.path.relpath(p, repo_root)))
+    return findings

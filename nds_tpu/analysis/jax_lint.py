@@ -1,0 +1,430 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Tracer-hazard lint: JAX-specific static checks over ``nds_tpu/``.
+
+Python-``ast`` based; no JAX import, no tracing. Rules (each suppressible
+with ``# nds-lint: ignore[rule]`` on the flagged line or the line above):
+
+* ``host-sync-in-loop`` — a device->host synchronization primitive
+  (``.item()``, ``np.asarray``/``np.array`` over device values,
+  ``jax.device_get``, ``float()``/``int()`` of arrays is not detectable
+  statically so it is out of scope) lexically inside a ``for``/``while``
+  loop of the hot-path modules (``engine/ops.py``, ``sql/planner.py``).
+  One sync per query is accounting; one per loop iteration is a dispatch
+  stall. Warning severity: the existing accounted reads are baselined.
+* ``tracer-if`` — a Python ``if``/``while`` whose test references a
+  non-static parameter of a ``jax.jit``-decorated function. Under tracing
+  this raises ``TracerBoolConversionError`` at best and silently bakes a
+  branch at worst.
+* ``cache-key-list`` — a raw ``list``/``set``/``dict`` display or
+  comprehension inside the key expression of a ``*_CACHE`` dict: lists are
+  unhashable, and even via tuple() the unbounded contents make the jit
+  cache key explode. A cache threaded through a helper as a plain
+  parameter (the planner's ``_fused_run(self, cache, ...)``) is covered
+  too: call sites passing a ``*_CACHE`` alias it to the callee's
+  parameter, and the callee's writes/evictions/keys count against the
+  module cache.
+* ``unbounded-cache`` — a module-level ``*_CACHE`` dict written by
+  subscript somewhere in its module with no eviction evidence (no
+  ``len()`` guard, ``pop``/``popitem``/``clear``) anywhere: every new key
+  pins a jitted executable for process lifetime.
+* ``time-in-jit`` — ``time.time()``/``time.perf_counter()`` inside a
+  ``jax.jit``-decorated function: it runs once at trace time and becomes
+  a constant in the compiled program.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from nds_tpu.analysis import Finding, suppressed
+
+# modules whose loops are hot paths (per-query, per-chunk dispatch loops)
+HOT_PATH_FILES = ("engine/ops.py", "sql/planner.py")
+
+_SYNC_NP_FUNCS = {"asarray", "array"}
+_TIME_FUNCS = {"time", "perf_counter", "perf_counter_ns", "monotonic"}
+
+
+def _is_jit_decorator(dec) -> tuple[bool, set]:
+    """(is jax.jit, static arg positions/names) for one decorator node."""
+    static: set = set()
+    # @jax.jit / @jit
+    if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+        return True, static
+    if isinstance(dec, ast.Name) and dec.id == "jit":
+        return True, static
+    # @functools.partial(jax.jit, static_argnums=(..)) / static_argnames
+    # and the decorator-factory spelling @jax.jit(static_argnums=(..))
+    if isinstance(dec, ast.Call):
+        f = dec.func
+        is_partial = (isinstance(f, ast.Attribute) and f.attr == "partial") \
+            or (isinstance(f, ast.Name) and f.id == "partial")
+        is_jit_factory = (isinstance(f, ast.Attribute) and f.attr == "jit") \
+            or (isinstance(f, ast.Name) and f.id == "jit")
+        if (is_partial and dec.args and _is_jit_decorator(dec.args[0])[0]) \
+                or is_jit_factory:
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnums", "static_argnames"):
+                    for elt in ast.walk(kw.value):
+                        if isinstance(elt, ast.Constant):
+                            static.add(elt.value)
+            return True, static
+    return False, static
+
+
+class _Lint(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, source: str):
+        self.rel = rel
+        self.lines = source.splitlines()
+        self.findings: list = []
+        self.scope_stack = ["<module>"]
+        self.loop_depth = 0
+        self.jit_params: list = []   # stack of traced-param name sets
+        self.jit_depth = 0           # count of enclosing jax.jit functions
+        self.is_hot = any(rel.endswith(h) for h in HOT_PATH_FILES)
+        # *_CACHE dicts assigned at module level in this file
+        self.module_caches: set = set()
+        self.cache_writes: dict = {}     # name -> [lineno]
+        self.cache_evictions: set = set()
+        # a module cache is often threaded through a helper as a plain
+        # parameter (planner's `_fused_run(self, cache, ...)`): record how
+        # each function USES its parameters cache-wise, plus every call
+        # site that passes a *_CACHE in, and join the two at finish()
+        self.fn_param_use: dict = {}     # func name -> (params, records)
+        self.param_use_stack: list = []  # (param names, {param: record})
+        self.cache_arg_calls: list = []  # (callee, pos|kwarg, cache name)
+
+    def _emit(self, rule: str, severity: str, message: str,
+              lineno: int) -> None:
+        if suppressed(self.lines, lineno, rule):
+            return
+        self.findings.append(Finding(self.rel, self.scope_stack[-1], rule,
+                                     severity, message, lineno))
+
+    # -- scope / jit tracking ----------------------------------------------
+
+    def visit_FunctionDef(self, node):
+        jit_static: set | None = None
+        for dec in node.decorator_list:
+            is_jit, static = _is_jit_decorator(dec)
+            if is_jit:
+                jit_static = static
+        self.scope_stack.append(node.name)
+        args = node.args
+        names = [a.arg for a in
+                 args.posonlyargs + args.args + args.kwonlyargs]
+        if jit_static is not None:
+            traced = {n for i, n in enumerate(names)
+                      if i not in jit_static and n not in jit_static}
+            self.jit_depth += 1
+        elif self.jit_depth:
+            # a nested helper defined inside a jit function still runs
+            # under the trace: closures over the enclosing traced params
+            # stay traced (its own params shadow them — their tracedness
+            # is not knowable statically, so they are not flagged)
+            traced = (self.jit_params[-1] if self.jit_params
+                      else set()) - set(names)
+        else:
+            traced = set()
+        self.jit_params.append(traced)
+        self.param_use_stack.append((names, {}))
+        saved_loop = self.loop_depth
+        self.loop_depth = 0
+        self.generic_visit(node)
+        self.loop_depth = saved_loop
+        self.jit_params.pop()
+        if jit_static is not None:
+            self.jit_depth -= 1
+        self.fn_param_use[node.name] = self.param_use_stack.pop()
+        self.scope_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _in_jit(self) -> bool:
+        return self.jit_depth > 0
+
+    # -- loops --------------------------------------------------------------
+
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_While(self, node):
+        self._check_tracer_test(node.test, node.lineno, "while")
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    def visit_If(self, node):
+        self._check_tracer_test(node.test, node.lineno, "if")
+        self.generic_visit(node)
+
+    def _check_tracer_test(self, test, lineno: int, kind: str) -> None:
+        if not self._in_jit():
+            return
+        traced = self.jit_params[-1]
+
+        def hazardous(node) -> bool:
+            # identity tests (x is None) are pytree-structure checks and
+            # .dtype/.shape/.ndim/.size are static metadata — both are
+            # legal on tracers
+            if isinstance(node, ast.Compare) and \
+                    all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops):
+                return False
+            if isinstance(node, ast.Attribute) and \
+                    node.attr in ("dtype", "shape", "ndim", "size"):
+                return False
+            if isinstance(node, ast.Name):
+                return node.id in traced
+            return any(hazardous(c) for c in ast.iter_child_nodes(node))
+
+        if hazardous(test):
+            names = sorted({n.id for n in ast.walk(test)
+                            if isinstance(n, ast.Name) and n.id in traced})
+            self._emit("tracer-if", "error",
+                       f"Python {kind} on traced parameter "
+                       f"{', '.join(repr(n) for n in names)} inside a "
+                       "jax.jit function", lineno)
+
+    # -- calls / attributes -------------------------------------------------
+
+    def visit_Call(self, node):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            owner = f.value.id if isinstance(f.value, ast.Name) else None
+            if self.is_hot and self.loop_depth > 0:
+                if f.attr == "item" and not node.args:
+                    self._emit("host-sync-in-loop", "warning",
+                               ".item() inside a hot-path loop blocks on "
+                               "device->host transfer per iteration",
+                               node.lineno)
+                elif owner in ("np", "numpy") and \
+                        f.attr in _SYNC_NP_FUNCS:
+                    self._emit("host-sync-in-loop", "warning",
+                               f"np.{f.attr}() inside a hot-path loop "
+                               "forces a device->host copy per iteration",
+                               node.lineno)
+                elif f.attr == "device_get":
+                    self._emit("host-sync-in-loop", "warning",
+                               "device_get() inside a hot-path loop",
+                               node.lineno)
+            if owner in ("time", "_time") and f.attr in _TIME_FUNCS and \
+                    self._in_jit():
+                self._emit("time-in-jit", "error",
+                           f"time.{f.attr}() inside a jax.jit function is "
+                           "evaluated once at trace time", node.lineno)
+        self._note_cache_method_write(node)
+        # a *_CACHE passed as an argument aliases it to the callee's
+        # parameter — resolved against the callee's use at finish()
+        callee, self_off = None, 0
+        if isinstance(f, ast.Name):
+            callee = f.id
+        elif isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self":
+            callee, self_off = f.attr, 1
+        if callee is not None:
+            for i, a in enumerate(node.args):
+                cname = self._is_cache_name(a)
+                if cname:
+                    self.cache_arg_calls.append(
+                        (callee, i + self_off, cname))
+            for kw in node.keywords:
+                cname = self._is_cache_name(kw.value)
+                if cname and kw.arg is not None:
+                    self.cache_arg_calls.append((callee, kw.arg, cname))
+        self.generic_visit(node)
+
+    # -- cache hygiene ------------------------------------------------------
+
+    def _is_cache_name(self, node) -> str | None:
+        if isinstance(node, ast.Name) and node.id.endswith("_CACHE"):
+            return node.id
+        return None
+
+    def _param_record(self, node) -> dict | None:
+        """The cache-use record for ``node`` when it names a parameter of
+        the innermost function, else None."""
+        if not (isinstance(node, ast.Name) and self.param_use_stack):
+            return None
+        params, records = self.param_use_stack[-1]
+        if node.id not in params:
+            return None
+        return records.setdefault(node.id, {
+            "write": None, "evict": False, "keyhaz": [],
+            "scope": self.scope_stack[-1]})
+
+    def visit_Assign(self, node):
+        # module-level NAME_CACHE = {} / dict()
+        if self.scope_stack == ["<module>"]:
+            for tgt in node.targets:
+                name = self._is_cache_name(tgt)
+                if name and isinstance(node.value, (ast.Dict, ast.Call)):
+                    self.module_caches.add(name)
+        # NAME_CACHE[key] = value
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                name = self._is_cache_name(tgt.value)
+                if name:
+                    self.cache_writes.setdefault(name, []).append(
+                        tgt.lineno)
+                    self._check_cache_key(name, tgt.slice, tgt.lineno)
+                else:
+                    rec = self._param_record(tgt.value)
+                    if rec is not None:
+                        if rec["write"] is None:
+                            rec["write"] = tgt.lineno
+                        rec["keyhaz"].extend(
+                            self._key_hazards(tgt.slice, tgt.lineno))
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if self.scope_stack == ["<module>"]:
+            name = self._is_cache_name(node.target)
+            if name and node.value is not None:
+                self.module_caches.add(name)
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        if isinstance(node.ctx, ast.Load):
+            name = self._is_cache_name(node.value)
+            if name:
+                self._check_cache_key(name, node.slice, node.lineno)
+            else:
+                rec = self._param_record(node.value)
+                if rec is not None:
+                    rec["keyhaz"].extend(
+                        self._key_hazards(node.slice, node.lineno))
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):
+        # len(NAME_CACHE) >= ... counts as eviction evidence
+        for sub in [node.left] + list(node.comparators):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Name) and \
+                    sub.func.id == "len" and sub.args:
+                name = self._is_cache_name(sub.args[0])
+                if name:
+                    self.cache_evictions.add(name)
+                else:
+                    rec = self._param_record(sub.args[0])
+                    if rec is not None:
+                        rec["evict"] = True
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):
+        if node.attr in ("pop", "popitem", "clear"):
+            name = self._is_cache_name(node.value)
+            if name:
+                self.cache_evictions.add(name)
+            else:
+                rec = self._param_record(node.value)
+                if rec is not None:
+                    rec["evict"] = True
+        self.generic_visit(node)
+
+    def _note_cache_method_write(self, node) -> None:
+        """CACHE.setdefault(k, v) / CACHE.update(...) grow the cache like a
+        subscript store does (setdefault's first argument is the key)."""
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr in ("setdefault", "update")):
+            return
+        name = self._is_cache_name(f.value)
+        if name:
+            self.cache_writes.setdefault(name, []).append(node.lineno)
+            if f.attr == "setdefault" and node.args:
+                self._check_cache_key(name, node.args[0], node.lineno)
+            return
+        rec = self._param_record(f.value)
+        if rec is not None:
+            if rec["write"] is None:
+                rec["write"] = node.lineno
+            if f.attr == "setdefault" and node.args:
+                rec["keyhaz"].extend(
+                    self._key_hazards(node.args[0], node.lineno))
+
+    def _key_hazards(self, key, lineno: int) -> list:
+        for n in ast.walk(key):
+            if isinstance(n, (ast.List, ast.ListComp, ast.Set, ast.SetComp,
+                              ast.Dict, ast.DictComp)):
+                return [(lineno, type(n).__name__)]
+        return []
+
+    def _check_cache_key(self, name: str, key, lineno: int) -> None:
+        for lineno, tname in self._key_hazards(key, lineno):
+            self._emit("cache-key-list", "error",
+                       f"raw {tname} in {name} key: unhashable and "
+                       "unbounded as a jit-cache key", lineno)
+
+    def _resolve_cache_aliases(self) -> None:
+        """Join call sites that pass a module *_CACHE with the callee's
+        parameter use, so writes/evictions/key hazards through the alias
+        count against the module cache."""
+        emitted: set = set()
+        for callee, pos, cname in self.cache_arg_calls:
+            info = self.fn_param_use.get(callee)
+            if info is None:
+                continue
+            params, records = info
+            pname = pos if isinstance(pos, str) else (
+                params[pos] if pos < len(params) else None)
+            rec = records.get(pname)
+            if rec is None:
+                continue
+            if rec["write"] is not None:
+                self.cache_writes.setdefault(cname, []).append(rec["write"])
+            if rec["evict"]:
+                self.cache_evictions.add(cname)
+            for lineno, tname in rec["keyhaz"]:
+                if (lineno, cname) in emitted:
+                    continue
+                emitted.add((lineno, cname))
+                self.scope_stack = ["<module>", rec["scope"]]
+                self._emit("cache-key-list", "error",
+                           f"raw {tname} in {cname} key (through parameter "
+                           f"{pname!r} of {callee}()): unhashable and "
+                           "unbounded as a jit-cache key", lineno)
+
+    def finish(self) -> None:
+        self._resolve_cache_aliases()
+        for name in sorted(self.module_caches):
+            writes = self.cache_writes.get(name)
+            if writes and name not in self.cache_evictions:
+                self.scope_stack = ["<module>"]
+                self._emit("unbounded-cache", "warning",
+                           f"{name} grows without eviction (no len() "
+                           "guard or pop/popitem/clear in module)",
+                           writes[0])
+
+
+def lint_file(path: str, rel: str | None = None) -> list:
+    with open(path) as f:
+        source = f.read()
+    rel = rel or path
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(rel, "<module>", "syntax-error", "error",
+                        str(e), e.lineno or 0)]
+    lint = _Lint(path, rel, source)
+    lint.visit(tree)
+    lint.finish()
+    return lint.findings
+
+
+def lint_tree(root: str | None = None) -> list:
+    """Lint every ``.py`` file under ``nds_tpu/`` (or ``root``)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(os.path.abspath(root))
+    findings: list = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                p = os.path.join(dirpath, fn)
+                findings.extend(lint_file(p, os.path.relpath(p, repo)))
+    return findings

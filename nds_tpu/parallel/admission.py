@@ -43,15 +43,31 @@ class DeviceAdmission:
         self.slots = slots
         self.dir = dir_path or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "nds_tpu_admission")
-        os.makedirs(self.dir, exist_ok=True)
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+        except PermissionError as e:
+            raise PermissionError(self._perm_msg(e)) from e
         self._held: int | None = None
         self._fds: dict[int, int] = {}
+
+    def _perm_msg(self, e: OSError) -> str:
+        return (f"admission dir {self.dir!r} is owned by another user "
+                f"({e.strerror}) — set NDS_TPU_ADMISSION_DIR to a path "
+                "this user can write (each dir is an independent slot "
+                "pool, so scoping a run also un-shares its throttle)")
 
     def _slot_fd(self, i: int) -> int:
         fd = self._fds.get(i)
         if fd is None:
-            fd = os.open(os.path.join(self.dir, f"slot{i}"),
-                         os.O_CREAT | os.O_RDWR, 0o644)
+            # the default dir is shared across users on purpose (one host,
+            # one device, one slot pool) — but another user's 0o644 slot
+            # files are EACCES on O_RDWR, which must fail loudly instead
+            # of crashing (or silently spinning) mid-campaign
+            try:
+                fd = os.open(os.path.join(self.dir, f"slot{i}"),
+                             os.O_CREAT | os.O_RDWR, 0o644)
+            except PermissionError as e:
+                raise PermissionError(self._perm_msg(e)) from e
             self._fds[i] = fd
         return fd
 
@@ -60,8 +76,12 @@ class DeviceAdmission:
         if self._held is not None:
             raise RuntimeError("slot already held")
         for i in range(self.slots):
+            # _slot_fd outside the flock try: its PermissionError must
+            # propagate, not be mistaken for a busy slot (which would turn
+            # acquire() into an infinite poll loop)
+            fd = self._slot_fd(i)
             try:
-                fcntl.flock(self._slot_fd(i), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
             except OSError:
                 continue
             self._held = i

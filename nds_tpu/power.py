@@ -279,6 +279,11 @@ def run_query_stream(input_prefix: str,
         execution_time_list.append((session.app_id, query_name, elapsed,
                                     round(compile_ms, 1)))
         q_report.summary["query"] = query_name
+        # JSON summaries must be distinguishable from official Power
+        # summaries the same way the time-log CSV marker rows are
+        # (test_warm.py): collectors globbing json_summary_folder filter
+        # on phase != 'Warm'
+        q_report.summary["phase"] = "Warm" if warm else "Power"
         queries_reports.append(q_report)
         if json_summary_folder:
             if property_file:

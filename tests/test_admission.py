@@ -120,3 +120,37 @@ def test_power_records_admission_fields(tmp_path, monkeypatch):
     doc = J.load(open(js[0]))
     assert doc.get("concurrentQueries") == 1
     assert "admissionQueuedMs" in doc
+
+
+def test_foreign_owned_slot_dir_fails_clearly(tmp_path, monkeypatch):
+    """Another user's 0o644 slot files EACCES on O_RDWR; the error must
+    name the fix (NDS_TPU_ADMISSION_DIR) instead of crashing with a bare
+    PermissionError — or worse, being swallowed as a busy slot and turning
+    acquire() into an infinite poll loop."""
+    from nds_tpu.parallel.admission import DeviceAdmission
+    a = DeviceAdmission(2, str(tmp_path))
+    real_open = os.open
+
+    def deny(path, *args, **kw):
+        if "slot" in os.path.basename(str(path)):
+            raise PermissionError(13, "Permission denied", str(path))
+        return real_open(path, *args, **kw)
+
+    monkeypatch.setattr(os, "open", deny)
+    with pytest.raises(PermissionError) as ei:
+        a.try_acquire()
+    assert "NDS_TPU_ADMISSION_DIR" in str(ei.value)
+    assert str(tmp_path) in str(ei.value)
+    a.close()
+
+
+def test_foreign_owned_admission_dir_fails_clearly(tmp_path, monkeypatch):
+    from nds_tpu.parallel.admission import DeviceAdmission
+
+    def deny(path, *args, **kw):
+        raise PermissionError(13, "Permission denied", str(path))
+
+    monkeypatch.setattr(os, "makedirs", deny)
+    with pytest.raises(PermissionError) as ei:
+        DeviceAdmission(1, str(tmp_path / "foreign"))
+    assert "NDS_TPU_ADMISSION_DIR" in str(ei.value)

@@ -1,0 +1,612 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Static analysis suite (nds_tpu/analysis): the plan auditor must pass the
+whole shipped corpus clean (modulo the checked-in baseline), each rule must
+trip on a known-bad fixture, in-source suppression must be honored, and the
+baseline diff must reject only NEW findings — the CI-gate contract of
+tools/lint.py."""
+
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TEMPLATES = os.path.join(REPO, "nds_tpu", "queries", "templates")
+
+
+def audit(sql: str):
+    from nds_tpu.analysis.plan_audit import PlanAuditor
+    return PlanAuditor().audit_sql(sql)
+
+
+def rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# plan auditor: full corpus
+# ---------------------------------------------------------------------------
+
+
+def test_corpus_passes_plan_audit_clean():
+    """All 99 templates (103 statements) audit clean: the only accepted
+    error is the TPC-DS spec's own deliberate cartesian in query77
+    (``from cs, cr`` — two per-call-center aggregates), which the
+    checked-in baseline carries."""
+    from nds_tpu.analysis.plan_audit import audit_corpus
+    findings = audit_corpus()
+    errors = [f for f in findings if f.severity == "error"]
+    assert [(f.file, f.rule) for f in errors] == \
+        [("query77.tpl", "cartesian-join")], \
+        "\n".join(str(f) for f in errors)
+
+
+def test_corpus_audit_is_deterministic():
+    from nds_tpu.analysis.plan_audit import audit_corpus
+    a = [f.key() for f in audit_corpus()]
+    b = [f.key() for f in audit_corpus()]
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# plan auditor: known-bad fixtures trip the expected rule
+# ---------------------------------------------------------------------------
+
+
+def test_unresolvable_column():
+    fs = audit("select ss_no_such_col from store_sales")
+    assert rules(fs) == {"unresolved-column"}
+    assert "ss_no_such_col" in fs[0].message
+
+
+def test_unresolvable_qualified_column():
+    fs = audit("select s.ss_item_sk from store_sales ss")
+    assert "unresolved-column" in rules(fs)
+
+
+def test_unknown_table():
+    fs = audit("select 1 x from no_such_table")
+    assert "unknown-table" in rules(fs)
+
+
+def test_dtype_mismatched_join():
+    # int32 surrogate key joined against a char(2) state column
+    fs = audit("select count(*) c from store_sales, store "
+               "where ss_store_sk = s_state")
+    assert "type-mismatch" in rules(fs)
+
+
+def test_dtype_mismatched_literal_comparison():
+    fs = audit("select count(*) c from store_sales "
+               "where ss_quantity = 'many'")
+    assert "type-mismatch" in rules(fs)
+    # ...while numeric and date/string coercions the corpus relies on pass
+    assert not audit("select count(*) c from date_dim "
+                     "where d_date between '1999-01-01' and '1999-02-01'")
+
+
+def test_cartesian_join_detected():
+    fs = audit("select count(*) c from store_sales, customer_demographics "
+               "where ss_quantity > 5")
+    assert "cartesian-join" in rules(fs)
+    assert "customer_demographics" in fs[-1].message
+
+
+def test_connected_join_not_cartesian():
+    fs = audit("select count(*) c from store_sales, store "
+               "where ss_store_sk = s_store_sk")
+    assert "cartesian-join" not in rules(fs)
+
+
+def test_single_row_subquery_exempt_from_cartesian():
+    # broadcasting a 1-row aggregate is a gather, not a pair explosion
+    fs = audit("select count(*) c from store_sales, "
+               "(select avg(ss_quantity) aq from store_sales) m "
+               "where ss_quantity > aq")
+    assert "cartesian-join" not in rules(fs)
+
+
+def test_constant_projection_subquery_not_single_row():
+    # select 1 from t is one row PER INPUT ROW: the exemption needs a
+    # real aggregate, or the flagship rule misses a true cross join
+    fs = audit("select count(*) c from store_sales, "
+               "(select 1 x from customer_demographics) m")
+    assert "cartesian-join" in rules(fs)
+
+
+def test_or_predicate_connects_but_and_does_not():
+    # an OR spanning two relations is evaluated per pair — a pair filter,
+    # not a cartesian...
+    assert "cartesian-join" not in rules(
+        audit("select count(*) c from store_sales, store "
+              "where ss_store_sk = 1 or s_store_sk = 2"))
+    # ...but an AND of single-relation filters decomposes into independent
+    # conjuncts and must still flag the unconnected pair
+    assert "cartesian-join" in rules(
+        audit("select count(*) c from store_sales, store "
+              "where ss_store_sk = 1 and s_store_sk = 2"))
+
+
+def test_unknown_function():
+    fs = audit("select percentile_disc(ss_quantity) p from store_sales")
+    assert "unknown-function" in rules(fs)
+
+
+def test_window_misuse_and_nested_aggregate():
+    assert "window-misuse" in rules(
+        audit("select rank() r from store_sales"))
+    assert "nested-aggregate" in rules(
+        audit("select sum(avg(ss_quantity)) s from store_sales"))
+    # q12-class windowed aggregate-over-aggregate is legal
+    assert not audit(
+        "select sum(sum(ss_ext_sales_price)) over (partition by ss_store_sk)"
+        " w from store_sales group by ss_store_sk, ss_ext_sales_price")
+
+
+def test_agg_in_where_and_agg_arg_type():
+    assert "agg-in-where" in rules(
+        audit("select ss_item_sk from store_sales "
+              "where sum(ss_quantity) > 5"))
+    assert "agg-arg-type" in rules(
+        audit("select sum(s_state) s from store group by s_store_sk"))
+
+
+def test_grouping_misuse():
+    assert "grouping-misuse" in rules(
+        audit("select grouping(ss_store_sk) g from store_sales"))
+    assert "grouping-misuse" in rules(
+        audit("select grouping(ss_item_sk) g from store_sales "
+              "group by rollup(ss_store_sk)"))
+    assert not audit("select grouping(ss_store_sk) g from store_sales "
+                     "group by rollup(ss_store_sk)")
+
+
+def test_setop_arity():
+    fs = audit("select ss_item_sk, ss_quantity from store_sales "
+               "union all select sr_item_sk from store_returns")
+    assert "setop-arity" in rules(fs)
+
+
+def test_duplicate_projected_names_keep_arity():
+    # duplicate output names collapse as scope keys but still count as
+    # columns: 2 vs 2 is NOT an arity error...
+    assert not audit(
+        "select ss_item_sk, ss_item_sk from store_sales "
+        "union all select sr_item_sk, sr_ticket_number from store_returns")
+    # ...and a dup-name 2-column IN subquery IS one
+    fs = audit("select ss_item_sk from store_sales where ss_item_sk in "
+               "(select sr_item_sk, sr_item_sk from store_returns)")
+    assert "subquery-arity" in rules(fs)
+
+
+def test_join_edge_through_non_comparison_predicates():
+    # IN-list / LIKE predicates spanning two relations connect them: the
+    # planner turns them into pair filters, not a cartesian
+    assert "cartesian-join" not in rules(
+        audit("select s.ss_item_sk from store_sales s, item i "
+              "where s.ss_item_sk in (i.i_item_sk)"))
+    assert "cartesian-join" not in rules(
+        audit("select s.ss_item_sk from store_sales s, item i "
+              "where i.i_item_id like 'AAA%' and s.ss_item_sk in "
+              "(i.i_item_sk, i_manufact_id)"))
+
+
+def test_cte_and_correlation_resolve():
+    # the query1 shape: CTE referenced twice + correlated scalar subquery
+    fs = audit(textwrap.dedent("""
+        with ctr as (select sr_customer_sk ctr_customer_sk,
+                            sr_store_sk ctr_store_sk,
+                            sum(sr_return_amt) ctr_total_return
+                     from store_returns, date_dim
+                     where sr_returned_date_sk = d_date_sk
+                     group by sr_customer_sk, sr_store_sk)
+        select c_customer_id from ctr ctr1, store, customer
+        where ctr1.ctr_total_return >
+              (select avg(ctr_total_return) * 1.2 from ctr ctr2
+               where ctr1.ctr_store_sk = ctr2.ctr_store_sk)
+          and s_store_sk = ctr1.ctr_store_sk
+          and ctr1.ctr_customer_sk = c_customer_sk
+        order by c_customer_id
+        limit 100"""))
+    assert not fs, "\n".join(str(f) for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# jax lint
+# ---------------------------------------------------------------------------
+
+
+def lint_snippet(tmp_path, code, rel="nds_tpu/engine/ops.py"):
+    from nds_tpu.analysis.jax_lint import lint_file
+    p = tmp_path / "snippet.py"
+    p.write_text(textwrap.dedent(code))
+    return lint_file(str(p), rel)
+
+
+def test_jax_lint_host_sync_in_loop(tmp_path):
+    fs = lint_snippet(tmp_path, """
+        import numpy as np
+        def drain(cols):
+            out = []
+            for c in cols:
+                out.append(c.total.item())
+                out.append(np.asarray(c.data))
+            return out
+    """)
+    assert [f.rule for f in fs] == ["host-sync-in-loop"] * 2
+    assert all(f.severity == "warning" for f in fs)
+
+
+def test_jax_lint_hot_path_scoping(tmp_path):
+    # the same sync outside the hot-path modules is not a finding
+    fs = lint_snippet(tmp_path, """
+        def drain(cols):
+            return [c.total.item() for c in cols]
+    """, rel="nds_tpu/report.py")
+    assert not fs
+
+
+def test_jax_lint_tracer_if_and_time(tmp_path):
+    fs = lint_snippet(tmp_path, """
+        import functools, time
+        import jax
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def kern(x, n):
+            t0 = time.time()
+            if n > 2:          # static arg: fine
+                x = x + 1
+            if x > 0:          # traced arg: hazard
+                return x
+            return x - t0
+    """)
+    assert sorted(f.rule for f in fs) == ["time-in-jit", "tracer-if"]
+    assert all(f.severity == "error" for f in fs)
+
+
+def test_jax_lint_nested_helper_and_argless_jit(tmp_path):
+    # a helper defined inside a jit function still runs under the trace:
+    # closures over the traced params keep tracer semantics, and an
+    # argless jit function still evaluates time.time() once at trace time
+    fs = lint_snippet(tmp_path, """
+        import time
+        import jax
+        @jax.jit
+        def f(x):
+            def inner():
+                if x > 0:
+                    return x + 1
+                return x
+            return inner()
+        @jax.jit
+        def g():
+            return time.time()
+    """)
+    assert sorted(f.rule for f in fs) == ["time-in-jit", "tracer-if"]
+    # ...but a nested helper's OWN params shadow the outer tracers and
+    # their tracedness is unknowable — not flagged
+    fs = lint_snippet(tmp_path, """
+        import jax
+        @jax.jit
+        def f(x):
+            def clamp(x):
+                if x is None:
+                    return 0
+                return x
+            return clamp(3)
+    """)
+    assert not fs, "\n".join(str(f) for f in fs)
+
+
+def test_jax_lint_static_metadata_if_ok(tmp_path):
+    fs = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        @jax.jit
+        def kern(x, valid):
+            if valid is None:                # pytree structure: fine
+                valid = jnp.ones(x.shape[0], bool)
+            if x.dtype == jnp.float64:       # static metadata: fine
+                x = x.astype(jnp.float32)
+            return x, valid
+    """)
+    assert not fs
+
+
+def test_jax_lint_factory_form_jit_decorator(tmp_path):
+    # @jax.jit(static_argnums=...) — the decorator-factory spelling — must
+    # be recognized like @jax.jit and functools.partial(jax.jit, ...)
+    fs = lint_snippet(tmp_path, """
+        import jax
+        @jax.jit(static_argnums=(1,))
+        def kern(x, n):
+            if n > 2:          # static arg: fine
+                x = x + 1
+            if x > 0:          # traced arg: hazard
+                return x
+            return x
+    """)
+    assert [f.rule for f in fs] == ["tracer-if"]
+
+
+def test_jax_lint_cache_through_parameter_alias(tmp_path):
+    # the planner threads _MASK_FUSE_CACHE/_EXPR_FUSE_CACHE through
+    # _fused_run's `cache` parameter: writes, evictions, and key hazards
+    # through the alias must count against the module cache
+    fs = lint_snippet(tmp_path, """
+        _ALIAS_CACHE: dict = {}
+        class P:
+            def outer(self, cols):
+                return self._run(_ALIAS_CACHE, cols)
+            def _run(self, cache, cols):
+                cache[(len(cols), [c.kind for c in cols])] = cols
+                return cols
+    """)
+    assert sorted(f.rule for f in fs) == ["cache-key-list",
+                                         "unbounded-cache"]
+    assert all("_ALIAS_CACHE" in f.message for f in fs)
+    # eviction through the alias clears unbounded-cache (the _fused_run
+    # shape: len() guard + pop through the parameter)
+    fs = lint_snippet(tmp_path, """
+        _ALIAS_CACHE: dict = {}
+        def outer(cols):
+            return _run(_ALIAS_CACHE, cols, 16)
+        def _run(cache, cols, cap):
+            if len(cache) >= cap:
+                cache.pop(next(iter(cache)))
+            cache[len(cols)] = cols
+            return cache[len(cols)]
+    """)
+    assert not fs, "\n".join(str(f) for f in fs)
+
+
+def test_jax_lint_cache_rules(tmp_path):
+    fs = lint_snippet(tmp_path, """
+        _GROW_CACHE: dict = {}
+        _BOUND_CACHE: dict = {}
+        _MAX = 16
+        def remember(key, cols, val):
+            _GROW_CACHE[(key, [c.kind for c in cols])] = val
+            if len(_BOUND_CACHE) >= _MAX:
+                _BOUND_CACHE.pop(next(iter(_BOUND_CACHE)))
+            _BOUND_CACHE[key] = val
+    """)
+    assert sorted(f.rule for f in fs) == ["cache-key-list", "unbounded-cache"]
+    assert all("_GROW_CACHE" in f.message for f in fs)
+
+
+def test_jax_lint_cache_setdefault_counts_as_write(tmp_path):
+    # a cache populated only via .setdefault() grows exactly like a
+    # subscript store — same hazard, same rule
+    fs = lint_snippet(tmp_path, """
+        _MISS_CACHE: dict = {}
+        def remember(k, cols, v):
+            return _MISS_CACHE.setdefault((k, [c.kind for c in cols]), v)
+    """)
+    assert sorted(f.rule for f in fs) == ["cache-key-list",
+                                         "unbounded-cache"]
+    fs = lint_snippet(tmp_path, """
+        _MISS_CACHE: dict = {}
+        def remember(k, v):
+            if len(_MISS_CACHE) >= 16:
+                _MISS_CACHE.popitem()
+            return _MISS_CACHE.setdefault(k, v)
+    """)
+    assert not fs, "\n".join(str(f) for f in fs)
+
+
+def test_jax_lint_suppression_honored(tmp_path):
+    fs = lint_snippet(tmp_path, """
+        def drain(cols):
+            out = []
+            for c in cols:
+                # nds-lint: ignore[host-sync-in-loop]
+                out.append(c.total.item())
+                v = c.n.item()  # nds-lint: ignore[host-sync-in-loop]
+                w = c.m.item()  # nds-lint: ignore[tracer-if] (wrong rule)
+            return out, v, w
+    """)
+    # only the wrong-rule suppression still fires
+    assert len(fs) == 1 and fs[0].rule == "host-sync-in-loop"
+
+
+def test_jax_lint_current_tree_clean():
+    """The engine itself must stay hazard-free beyond the baseline (which
+    carries none for jax-lint today)."""
+    from nds_tpu.analysis.jax_lint import lint_tree
+    fs = lint_tree(os.path.join(REPO, "nds_tpu"))
+    assert not fs, "\n".join(str(f) for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# driver audit
+# ---------------------------------------------------------------------------
+
+
+def driver_snippet(tmp_path, code):
+    from nds_tpu.analysis.driver_audit import audit_file
+    p = tmp_path / "driver.py"
+    p.write_text(textwrap.dedent(code))
+    return audit_file(str(p), "tools/driver.py")
+
+
+def test_driver_audit_rules(tmp_path):
+    fs = driver_snippet(tmp_path, """
+        import json, os, subprocess
+        def run(cmd, out_path, doc):
+            try:
+                os.system("rm -rf " + cmd)
+                subprocess.run(cmd, shell=True)
+            except Exception:
+                pass
+            json.dump(doc, open(out_path, "w"))
+    """)
+    assert sorted(f.rule for f in fs) == [
+        "shell-injection", "shell-injection", "swallowed-exception",
+        "unmanaged-file-handle"]
+
+
+def test_driver_audit_shell_true_through_aliases(tmp_path):
+    # shell=True is the hazard regardless of the callee's spelling:
+    # `from subprocess import run` and `import subprocess as sp` must not
+    # slip past the error-severity gate
+    fs = driver_snippet(tmp_path, """
+        import subprocess as sp
+        from subprocess import run
+        def go(cmd):
+            run(cmd, shell=True)
+            sp.run(cmd, shell=True)
+            sp.check_output(cmd, shell=False)
+    """)
+    assert [f.rule for f in fs] == ["shell-injection"] * 2
+
+
+def test_driver_audit_managed_patterns_ok(tmp_path):
+    fs = driver_snippet(tmp_path, """
+        import json, subprocess
+        def run(argv, out_path, doc):
+            subprocess.run(argv, capture_output=True)
+            with open(out_path, "w") as f:
+                json.dump(doc, f)
+            g = open(out_path + ".tmp", "w")
+            try:
+                g.write("x")
+            finally:
+                g.close()
+            try:
+                return json.load(open(out_path))  # nds-lint: ignore
+            except OSError:
+                pass
+    """)
+    assert not fs, "\n".join(str(f) for f in fs)
+
+
+def test_driver_audit_rebound_handle_leak(tmp_path):
+    # reusing a name for two sequential open()s leaks the first handle;
+    # close-then-reopen is fine but the second handle needs its own close
+    fs = driver_snippet(tmp_path, """
+        def two_logs(a, b):
+            f = open(a, "w")
+            f.write("x")
+            f = open(b, "w")
+            f.close()
+    """)
+    assert [f.rule for f in fs] == ["unmanaged-file-handle"]
+    assert fs[0].line == 3   # the FIRST open is the leak
+    fs = driver_snippet(tmp_path, """
+        def two_logs(a, b):
+            f = open(a, "w")
+            f.close()
+            f = open(b, "w")
+            f.write("x")
+    """)
+    assert [(f.rule, f.line) for f in fs] == [("unmanaged-file-handle", 5)]
+
+
+def test_driver_audit_annotated_assign_handle(tmp_path):
+    # f: IO = open(p) tracks like f = open(p): closed is clean, unclosed
+    # is a finding
+    fs = driver_snippet(tmp_path, """
+        def go(p):
+            f: object = open(p)
+            f.close()
+    """)
+    assert not fs, "\n".join(str(f) for f in fs)
+    fs = driver_snippet(tmp_path, """
+        def go(p):
+            f: object = open(p)
+            return f.read()
+    """)
+    assert [f.rule for f in fs] == ["unmanaged-file-handle"]
+
+
+def test_driver_audit_attribute_held_handle_ok(tmp_path):
+    # a handle stored on an object has a deliberate cross-method lifetime
+    fs = driver_snippet(tmp_path, """
+        class Log:
+            def start(self, path):
+                self.f = open(path, "w")
+            def stop(self):
+                self.f.close()
+    """)
+    assert not fs, "\n".join(str(f) for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# baseline diffing + CI gate
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_rejects_only_new_findings():
+    from nds_tpu.analysis import Finding, diff_against_baseline
+    old = Finding("a.py", "f", "rule-x", "warning", "msg")
+    dup = Finding("a.py", "f", "rule-x", "warning", "msg")
+    new = Finding("b.py", "g", "rule-y", "error", "other")
+    baseline = {old.key(): 1}
+    assert diff_against_baseline([old, new], baseline) == [new]
+    # a second instance of an accepted finding is NEW (count semantics)
+    assert diff_against_baseline([old, dup], baseline) == [dup]
+    assert diff_against_baseline([old], {}) == [old]
+
+
+def test_baseline_roundtrip(tmp_path):
+    from nds_tpu.analysis import (Finding, diff_against_baseline,
+                                  load_baseline, write_baseline)
+    fs = [Finding("a.py", "f", "r", "warning", "m"),
+          Finding("a.py", "f", "r", "warning", "m")]
+    path = str(tmp_path / "baseline.json")
+    write_baseline(fs, path)
+    assert diff_against_baseline(fs, load_baseline(path)) == []
+
+
+def _run_lint(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), *argv],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_lint_cli_gate(tmp_path):
+    """The shipped baseline gates clean; a seeded bad template fails."""
+    r = _run_lint("--json", str(tmp_path / "report.json"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.load(open(tmp_path / "report.json"))
+    assert report["pass_counts"]["plan-audit"] >= 1
+    assert not report["new"]
+
+    seeded = tmp_path / "templates"
+    shutil.copytree(TEMPLATES, seeded)
+    (seeded / "querybad.tpl").write_text(
+        "select ss_no_such from store_sales, customer_demographics\n")
+    with open(seeded / "templates.lst", "a") as f:
+        f.write("querybad.tpl\n")
+    r = _run_lint("--templates", str(seeded))
+    assert r.returncode == 2
+    assert "unresolved-column" in r.stdout
+    assert "cartesian-join" in r.stdout
+
+
+def test_lint_cli_update_baseline_refuses_foreign_corpus(tmp_path):
+    """--update-baseline over a --templates corpus must not clobber the
+    checked-in baseline; an explicit --baseline path makes it legal."""
+    seeded = tmp_path / "templates"
+    shutil.copytree(TEMPLATES, seeded)
+    shipped = os.path.join(REPO, "nds_tpu", "analysis", "baseline.json")
+    before = open(shipped).read()
+    r = _run_lint("--templates", str(seeded), "--update-baseline")
+    assert r.returncode != 0
+    assert "foreign corpus" in r.stderr
+    assert open(shipped).read() == before
+    alt = str(tmp_path / "alt_baseline.json")
+    report = tmp_path / "accepted.json"
+    r = _run_lint("--templates", str(seeded), "--update-baseline",
+                  "--baseline", alt, "--json", str(report))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert os.path.exists(alt)
+    # --json alongside --update-baseline still writes the report, showing
+    # what was just accepted relative to the pre-update baseline
+    assert json.load(open(report))["all"]
+    r = _run_lint("--templates", str(seeded), "--baseline", alt)
+    assert r.returncode == 0, r.stdout + r.stderr

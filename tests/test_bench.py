@@ -4,6 +4,7 @@
 import importlib.util
 import json
 import os
+import sys
 
 import pytest
 
@@ -140,3 +141,32 @@ def test_first_partial_run_seeds_baseline(tmp_path):
     assert json.load(open(f))["n_queries"] == 102   # what was measured
     vs2 = bench.resolve_baseline(str(f), _times(50, 102), 103)
     assert abs(vs2 - 2.0) < 1e-9
+
+
+def test_collect_sf10_failure_capture_excludes_restart_suffix(tmp_path):
+    """The abort-regex capture must stop at the cause: the launcher's
+    '; restarting child' suffix is launcher noise, not failure reason
+    (ADVICE.md round-5 item 4)."""
+    spec2 = importlib.util.spec_from_file_location(
+        "collect_sf10", os.path.join(REPO, "tools", "collect_sf10.py"))
+    collect = importlib.util.module_from_spec(spec2)
+    spec2.loader.exec_module(collect)
+    jsonl = tmp_path / "results.jsonl"
+    jsonl.write_text(json.dumps({"name": "query1", "ms": 1234.5}) + "\n")
+    log = tmp_path / "stderr.log"
+    log.write_text(
+        "# query9 aborted (timeout after 600s); restarting child\n"
+        "# query70 failed: ExecError boom; restarting child\n"
+        "# query88 failed: plain failure line\n")
+    out = tmp_path / "SF10.json"
+    argv = sys.argv
+    sys.argv = ["collect_sf10.py", str(jsonl), str(log), str(out)]
+    try:
+        collect.main()
+    finally:
+        sys.argv = argv
+    doc = json.load(open(out))
+    assert doc["queries"]["query1"]["timed_s"] == 1.234
+    assert doc["failures"]["query9"] == "(timeout after 600s)"
+    assert doc["failures"]["query70"] == "ExecError boom"
+    assert doc["failures"]["query88"] == "plain failure line"

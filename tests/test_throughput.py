@@ -44,3 +44,44 @@ def test_two_concurrent_streams(tmp_path):
         assert "query3" in names and "query52" in names
         js = list((tmp_path / f"json_{s}").glob("*.json"))
         assert len(js) == 2
+
+
+def _load_sweep():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "throughput_sweep", os.path.join(REPO, "tools",
+                                         "throughput_sweep.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sweep_survives_stream_missing_end_marker(tmp_path, monkeypatch):
+    """A stream killed after 'Power Start Time' but before 'Power End Time'
+    must be recorded as an error, not abort the whole sweep with a
+    TypeError on en - st (ADVICE.md round-5 item 1)."""
+    sweep = _load_sweep()
+
+    def rows(path, rows_):
+        with open(path, "w", newline="") as f:
+            csv.writer(f).writerows(rows_)
+
+    base = str(tmp_path / "s2_a0")
+    rows(base + "_1.csv", [
+        ["app", "query", "time"],
+        ["a", "Power Start Time", "1000"], ["a", "query1", "5"],
+        ["a", "query2", "7"], ["a", "Power End Time", "1010"]])
+    rows(base + "_2.csv", [          # crashed: start marker, no end marker
+        ["app", "query", "time"],
+        ["a", "Power Start Time", "1002"], ["a", "query1", "6"]])
+    monkeypatch.setattr(
+        sweep.subprocess, "run",
+        lambda *a, **k: subprocess.CompletedProcess(a, 1, "", "killed"))
+    info = sweep.run_config(2, 0, "data", "streams", str(tmp_path),
+                            None, "parquet")
+    assert info["streams"][2] == {"error": "missing end marker",
+                                  "queries": 1}
+    # the surviving stream still yields spec Ttt over its own bounds
+    assert info["streams"][1] == {"wall_s": 10, "queries": 2}
+    assert info["Ttt_s"] == 10
+    assert info["total_queries"] == 2

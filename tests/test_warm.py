@@ -32,3 +32,33 @@ def test_warm_run_writes_warm_markers(tmp_path, monkeypatch):
     assert "Warm Test Time" in names and "Warm Start Time" in names
     assert not any(n.startswith("Power") for n in names), \
         "a warm report must never be parseable as a Power Run"
+
+def test_warm_run_stamps_phase_in_json_summaries(tmp_path, monkeypatch):
+    """Per-query JSON summaries must carry the same Warm/Power marker the
+    CSV rows do: collectors globbing json_summary_folder filter on phase,
+    so a warm pass invoked with --json_summary_folder must never produce
+    summaries indistinguishable from official Power summaries."""
+    import glob
+    import json
+
+    from nds_tpu import power
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.types import to_arrow as to_pa
+    fields = get_schemas(use_decimal=True)["item"]
+    monkeypatch.setattr(power, "get_schemas",
+                        lambda use_decimal: {"item": fields})
+    data = tmp_path / "data"
+    (data / "item").mkdir(parents=True)
+    cols = {f.name: pa.array([None, None], to_pa(f.type)) for f in fields}
+    cols["i_item_sk"] = pa.array([1, 2], to_pa(fields[0].type))
+    pq.write_table(pa.table(cols), data / "item" / "part-0.parquet")
+    for warm, expect in ((True, "Warm"), (False, "Power")):
+        out = tmp_path / f"json_{expect}"
+        power.run_query_stream(str(data), None,
+                               OrderedDict(q="select count(*) c from item"),
+                               str(tmp_path / f"log_{expect}.csv"),
+                               json_summary_folder=str(out), warm=warm)
+        js = glob.glob(str(out / "*.json"))
+        assert js
+        with open(js[0]) as f:
+            assert json.load(f).get("phase") == expect

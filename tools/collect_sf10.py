@@ -33,7 +33,11 @@ def main():
                 row = {"timed_s": round(msg["ms"] / 1e3, 3)}
                 row.update({k: msg[k] for k in KEYS if k in msg})
                 queries[msg["name"]] = row
-    fail = re.compile(r"^# (query\S+) (?:failed|aborted)[:\s]*(.*)")
+    # capture stops before the launcher's '; restarting child' suffix so
+    # the committed failures map carries only the cause, e.g.
+    # '(timeout after 600s)'
+    fail = re.compile(
+        r"^# (query\S+) (?:failed|aborted)[:\s]*(.*?)(?:; restarting child)?$")
     try:
         with open(log_path) as f:
             for ln in f:
@@ -61,7 +65,8 @@ def main():
         "queries": queries,
         "failures": failures,
     }
-    json.dump(doc, open(out_path, "w"), indent=1)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
     print(f"wrote {out_path}: {len(queries)} measured, "
           f"{len(failures)} failed")
 

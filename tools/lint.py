@@ -1,0 +1,119 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Static analysis gate: plan auditor + engine lint + driver lint.
+
+Runs the three :mod:`nds_tpu.analysis` passes entirely on host (no device,
+no data) and exits nonzero when any finding is NOT covered by the
+checked-in baseline (``nds_tpu/analysis/baseline.json``) — the accepted
+pre-existing findings. New code must come in clean; accepting a new
+finding is an explicit act (``--update-baseline``) that shows up in
+review as a baseline diff.
+
+Usage:
+    python tools/lint.py                      # gate against the baseline
+    python tools/lint.py --json report.json   # machine-readable findings
+    python tools/lint.py --templates DIR      # audit a different corpus
+    python tools/lint.py --update-baseline    # accept current findings
+    python tools/lint.py --no-baseline        # print everything, exit 0/2
+                                              # on any finding at all
+
+In-source suppression for the code lints: ``# nds-lint: ignore[rule]`` on
+the flagged line or the line above.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the passes parse SQL and Python source only — keep any accidental device
+# backend out of the loop (import of nds_tpu initialises jax)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from nds_tpu.analysis import (BASELINE_PATH, diff_against_baseline,  # noqa: E402
+                              load_baseline, write_baseline)
+from nds_tpu.analysis.driver_audit import audit_drivers  # noqa: E402
+from nds_tpu.analysis.jax_lint import lint_tree  # noqa: E402
+from nds_tpu.analysis.plan_audit import audit_corpus  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_passes(template_dir=None):
+    t0 = time.time()
+    findings = []
+    counts = {}
+    for name, fn in (("plan-audit",
+                      lambda: audit_corpus(template_dir)),
+                     ("jax-lint", lambda: lint_tree(
+                         os.path.join(REPO, "nds_tpu"))),
+                     ("driver-audit", lambda: audit_drivers(REPO))):
+        got = fn()
+        counts[name] = len(got)
+        findings.extend(got)
+    return findings, counts, time.time() - t0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="nds-tpu static analysis gate")
+    ap.add_argument("--templates", default=None,
+                    help="query template dir to audit (default: the "
+                    "shipped corpus)")
+    ap.add_argument("--json", default=None,
+                    help="write the full findings report to this path")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: the checked-in one)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to accept current findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report all findings")
+    args = ap.parse_args(argv)
+    if args.update_baseline and args.templates and args.baseline is None:
+        ap.error("--update-baseline over a --templates corpus would "
+                 "overwrite the checked-in baseline with findings from a "
+                 "foreign corpus; pass an explicit --baseline path")
+    baseline_path = args.baseline or BASELINE_PATH
+
+    findings, counts, elapsed = run_passes(args.templates)
+
+    # diff against the PRE-update baseline so a --json report written
+    # alongside --update-baseline shows what was just accepted
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new = diff_against_baseline(findings, baseline)
+
+    if args.json:
+        doc = {
+            "elapsed_s": round(elapsed, 2),
+            "pass_counts": counts,
+            "baseline_covered": len(findings) - len(new),
+            "new": [f.to_dict() for f in new],
+            "all": [f.to_dict() for f in findings],
+        }
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+
+    if args.update_baseline:
+        write_baseline(findings, baseline_path)
+        print(f"baseline updated: {baseline_path} "
+              f"({len(findings)} accepted findings)")
+        return 0
+
+    for f in new:
+        print(f"NEW {f}")
+    n_err = sum(1 for f in new if f.severity == "error")
+    summary = ", ".join(f"{name}: {n}" for name, n in counts.items())
+    print(f"# lint: {summary}; {len(findings) - len(new)} baselined, "
+          f"{len(new)} new ({n_err} errors) in {elapsed:.1f}s")
+    if new:
+        print("# gate FAILED: fix the findings above, suppress with "
+              "'# nds-lint: ignore[rule]', or accept deliberately with "
+              "tools/lint.py --update-baseline")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,12 @@ def run_config(n_streams, admission, data_dir, stream_dir, work_dir,
         if st is None:
             info["streams"][i] = {"error": "missing markers"}
             continue
+        if en is None:
+            # stream died between writing 'Power Start Time' and 'Power End
+            # Time' — record it and keep sweeping the remaining configs
+            info["streams"][i] = {"error": "missing end marker",
+                                  "queries": nq}
+            continue
         starts.append(st)
         ends.append(en)
         total_q += nq
@@ -121,13 +127,14 @@ def main():
         results.append(info)
         print(json.dumps({k: v for k, v in info.items()
                           if k != "streams"}), flush=True)
-        json.dump({"note": (
-            "Stream-concurrency scaling on one chip: spec Ttt = "
-            "max(stream end) - min(stream start) per configuration; "
-            "admission_slots is the NDS_TPU_CONCURRENT_QUERIES "
-            "device-sharing knob (0 = unlimited interleaving)."),
-            "sub_queries": args.sub_queries or "full streams",
-            "configs": results}, open(args.out, "w"), indent=1)
+        with open(args.out, "w") as out_f:
+            json.dump({"note": (
+                "Stream-concurrency scaling on one chip: spec Ttt = "
+                "max(stream end) - min(stream start) per configuration; "
+                "admission_slots is the NDS_TPU_CONCURRENT_QUERIES "
+                "device-sharing knob (0 = unlimited interleaving)."),
+                "sub_queries": args.sub_queries or "full streams",
+                "configs": results}, out_f, indent=1)
     print(f"# wrote {args.out} ({len(results)} configs)")
 
 
