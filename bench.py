@@ -149,7 +149,15 @@ def run_server():
             sess.read_columnar_view(
                 table, path, "parquet",
                 canonical_types={f.name: f.type for f in fields})
-    print(json.dumps({"ready": True}), flush=True)
+    try:
+        # provenance: the platform that actually executes, stamped into
+        # PERF.md by the parent (BENCH_r05 ran 3000s against a chip that
+        # never came up — the header must say what really ran, not assume)
+        import jax as _jax
+        platform = _jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+    print(json.dumps({"ready": True, "platform": platform}), flush=True)
 
     from nds_tpu.engine import ops as _ops
 
@@ -179,9 +187,12 @@ def run_server():
             t1 = time.perf_counter()
             # roofline decomposition measured on the final pass (sync
             # counts are deterministic per query; wait time is weather)
+            from nds_tpu.listener import drain_stream_events
+            drain_stream_events()        # count only the final pass's scans
             s0, w0 = _ops.sync_count(), _ops.sync_wait_ns()
             sess.sql(sql).collect()
             t2 = time.perf_counter()
+            stream_events = drain_stream_events()
             ms = min(t1 - t0, t2 - t1) * 1000.0
             syncs = _ops.sync_count() - s0
             sync_ms = (_ops.sync_wait_ns() - w0) / 1e6
@@ -203,6 +214,15 @@ def run_server():
                 # compile-cost axis the SF10 scaling question turns on
                 "warmS": round(t0 - tw, 2),
                 "compileS": round(compile_s, 2)}
+            if stream_events:
+                # >HBM streamed scans: which path served each (compiled
+                # chunk pipeline vs eager chunk loop), chunk/sync counts
+                # — the per-query face of the streamed sync budget
+                result["streamedScans"] = [
+                    {"table": e.where, "chunks": e.chunks,
+                     "syncs": e.syncs, "path": e.path,
+                     **({"reason": e.reason} if e.reason else {})}
+                    for e in stream_events]
             try:
                 # per-query HBM footprint where the backend exposes
                 # allocator stats (local chips; the tunneled attachment
@@ -290,12 +310,12 @@ class ChildServer:
         threading.Thread(target=self._reader,
                          args=(self.proc, self.lines), daemon=True).start()
         msg = self._next_json(min(SETUP_TIMEOUT_S, deadline_left))
-        ok = bool(msg and msg.get("ready"))
-        if not ok:
+        if not (msg and msg.get("ready")):
             # a slow-to-start child left alive would desync the protocol:
             # its late "ready" line would be consumed as a query response
             self.stop()
-        return ok
+            return None
+        return msg
 
     def _next_json(self, timeout):
         end = time.perf_counter() + timeout
@@ -335,23 +355,33 @@ class ChildServer:
         self.proc = None
 
 
-def write_perf(times, perf):
+def write_perf(times, perf, platform="unknown"):
     """PERF.md: the per-query roofline table (wall, host-sync count and
     blocked time, bytes scanned, effective bandwidth) the geomean headline
     decomposes into. Committed alongside BENCH_r{N}.json so 'is it fast?'
-    is answerable from artifacts (device vs host split per query)."""
+    is answerable from artifacts (device vs host split per query).
+    ``platform`` is the serving child's ``jax.devices()[0].platform`` —
+    real provenance, not an assumed "attached chip"."""
     if not perf:
         return
     rows = sorted(times)
     tot_sync = sum(p.get("syncWaitMs", 0) for p in perf.values())
     tot_ms = sum(times.values())
+    streamed = [e for p in perf.values()
+                for e in p.get("streamedScans", [])]
     with open(os.path.join(REPO, "PERF.md"), "w") as f:
         f.write("# Power Run roofline decomposition\n\n")
-        f.write(f"Scale factor {SCALE}; warm min-of-2 wall times on the "
-                "attached chip.\n"
+        f.write(f"Scale factor {SCALE}; warm min-of-2 wall times; "
+                f"platform: {platform}.\n"
                 f"Aggregate: {len(times)} queries, "
                 f"{tot_sync / max(tot_ms, 1e-9) * 100:.1f}% of summed wall "
-                "time blocked on device->host reads.\n\n")
+                "time blocked on device->host reads.\n")
+        if streamed:
+            n_comp = sum(1 for e in streamed if e["path"] == "compiled")
+            f.write(f"Streamed >HBM scans: {len(streamed)} "
+                    f"({n_comp} compiled chunk pipeline, "
+                    f"{len(streamed) - n_comp} eager fallback).\n")
+        f.write("\n")
         f.write("| query | wall ms | warm s | compile s | host syncs | "
                 "sync wait ms | scan MB | scan GB/s |\n"
                 "|---|---|---|---|---|---|---|---|\n")
@@ -368,35 +398,48 @@ def write_perf(times, perf):
 _emitted = False
 
 
-def emit(times, n_total):
-    """Print the one JSON metric line (idempotent; also the signal path)."""
+def emit(times, n_total, aborted=None):
+    """Print the one JSON metric line (idempotent; also the signal path).
+    ``aborted`` labels a fail-fast partial artifact (circuit breaker) so a
+    collector can tell "measured everything" from "gave up early"."""
     global _emitted
     if _emitted:
         return
     _emitted = True
     if not times:
-        print(json.dumps({"metric": "power_geomean_ms", "value": None,
-                          "unit": "ms", "vs_baseline": 0.0, "n_queries": 0}))
+        out = {"metric": "power_geomean_ms", "value": None,
+               "unit": "ms", "vs_baseline": 0.0, "n_queries": 0}
+        if aborted:
+            out["aborted"] = aborted
+        print(json.dumps(out))
         return
     geomean = _geomean(list(times.values()))
     vs = resolve_baseline(os.path.join(REPO, "BASELINE_TIMES.json"),
                           times, n_total)
-    print(json.dumps({
+    out = {
         "metric": "power_geomean_ms",
         "value": round(geomean, 3),
         "unit": "ms",
         "vs_baseline": round(vs, 4),
         "n_queries": len(times),
-    }), flush=True)
+    }
+    if aborted:
+        out["aborted"] = aborted
+    print(json.dumps(out), flush=True)
 
 
 def load_resume(path, times, perf):
     """Pre-populate times/perf from a previous campaign's results file so
     an at-scale run (SF10: minutes/query) is resumable across invocations
     — measured queries are never re-paid (round-4 verdict: the first SF10
-    campaign stopped at 30/103 and the partial work was lost)."""
+    campaign stopped at 30/103 and the partial work was lost). Returns the
+    platform the original campaign stamped (its ``{"platform": ...}`` meta
+    line), or None: a rerun satisfied entirely from the resume file starts
+    no child and would otherwise overwrite PERF.md's real provenance with
+    "unknown"."""
+    platform = None
     if not path or not os.path.exists(path):
-        return
+        return platform
     with open(path) as f:
         for ln in f:
             try:
@@ -407,8 +450,12 @@ def load_resume(path, times, perf):
                 times[msg["name"]] = msg["ms"]
                 perf[msg["name"]] = {k: msg[k] for k in
                                      ("hostSyncs", "syncWaitMs", "scanBytes",
-                                      "scanGBps", "warmS", "compileS")
+                                      "scanGBps", "warmS", "compileS",
+                                      "streamedScans")
                                      if k in msg}
+            elif "platform" in msg:
+                platform = msg["platform"]
+    return platform
 
 
 def run_parent(t_entry):
@@ -420,7 +467,7 @@ def run_parent(t_entry):
     names = []
     child = ChildServer()
     resume_path = os.environ.get("NDS_BENCH_RESULTS_JSONL")
-    load_resume(resume_path, times, perf)
+    resume_platform = load_resume(resume_path, times, perf)
     resume_f = open(resume_path, "a") if resume_path else None
 
     def on_signal(signum, frame):
@@ -445,13 +492,39 @@ def run_parent(t_entry):
         print(f"# resume: {len(times)} queries pre-loaded from "
               f"{os.path.basename(resume_path)}", file=sys.stderr)
     attempts = {}
+    platform = resume_platform or "unknown"
+    aborted = None
+    setup_fails = 0
     while pending and left() > 0:
         if not child.alive():
             if restarts > 6:                          # crash-looping backend
                 break
             restarts += 1
-            if not child.start(left()):
+            ready = child.start(left())
+            if ready is None:
+                # circuit breaker: BENCH_r05 burned its whole 3000s budget
+                # on six consecutive 300s setup timeouts against a backend
+                # that never came up — after 2 in a row, stop paying and
+                # emit the labeled partial artifact instead
+                setup_fails += 1
+                if setup_fails >= 2:
+                    aborted = "child-setup-failure"
+                    print(f"# {setup_fails} consecutive child-setup "
+                          "failures: backend is not coming up; "
+                          "failing fast with a partial artifact",
+                          file=sys.stderr)
+                    break
                 continue                              # dead child -> retry
+            setup_fails = 0
+            new_plat = ready.get("platform", "unknown")
+            if new_plat != "unknown" and new_plat != platform:
+                platform = new_plat
+                if resume_f is not None:
+                    # provenance meta line: lets a later rerun that never
+                    # starts a child still stamp the real platform
+                    resume_f.write(json.dumps({"platform": platform})
+                                   + "\n")
+                    resume_f.flush()
         name = pending.pop(0)
         attempts[name] = attempts.get(name, 0) + 1
         deadline = min(PER_QUERY_TIMEOUT_S, left())
@@ -474,7 +547,8 @@ def run_parent(t_entry):
             times[msg["name"]] = msg["ms"]
             perf[msg["name"]] = {k: msg[k] for k in
                                  ("hostSyncs", "syncWaitMs", "scanBytes",
-                                  "scanGBps", "warmS", "compileS")
+                                  "scanGBps", "warmS", "compileS",
+                                  "streamedScans")
                                  if k in msg}
             if resume_f is not None:
                 resume_f.write(json.dumps(msg) + "\n")
@@ -488,8 +562,8 @@ def run_parent(t_entry):
     if times and len(times) < len(names):
         print(f"# measured {len(times)}/{len(names)} queries",
               file=sys.stderr)
-    write_perf(times, perf)
-    emit(times, len(names))
+    write_perf(times, perf, platform)
+    emit(times, len(names), aborted)
     if not times:
         sys.exit(1)
 

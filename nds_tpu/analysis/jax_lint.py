@@ -30,6 +30,15 @@ with ``# nds-lint: ignore[rule]`` on the flagged line or the line above):
 * ``time-in-jit`` — ``time.time()``/``time.perf_counter()`` inside a
   ``jax.jit``-decorated function: it runs once at trace time and becomes
   a constant in the compiled program.
+* ``chunk-loop-host-sync`` — a host-sync primitive (``.item()``,
+  ``np.asarray``/``np.array``, ``device_get``, ``.to_int()``, or the
+  engine's ``host_sync``/``count_int``/``resolve_counts``) lexically
+  inside a ``for`` loop over ``device_chunks()``/``padded_chunks()``,
+  in ANY module. A >HBM table streams hundreds of chunks: one sync per
+  chunk is the O(chunks) control-plane cost the compiled streaming
+  executor (``engine/stream.py``) exists to remove — new chunk loops
+  must stay device-resident or route through it. The surviving eager
+  fallback loop is baselined.
 """
 
 from __future__ import annotations
@@ -44,6 +53,10 @@ HOT_PATH_FILES = ("engine/ops.py", "sql/planner.py")
 
 _SYNC_NP_FUNCS = {"asarray", "array"}
 _TIME_FUNCS = {"time", "perf_counter", "perf_counter_ns", "monotonic"}
+# iterator methods that yield device chunks of a >HBM streamed table
+_CHUNK_ITER_FUNCS = {"device_chunks", "padded_chunks"}
+# engine entry points that resolve a device scalar on host
+_ENGINE_SYNC_FUNCS = {"host_sync", "count_int", "resolve_counts"}
 
 
 def _is_jit_decorator(dec) -> tuple[bool, set]:
@@ -80,6 +93,7 @@ class _Lint(ast.NodeVisitor):
         self.findings: list = []
         self.scope_stack = ["<module>"]
         self.loop_depth = 0
+        self.chunk_loop_depth = 0    # for-loops over device/padded chunks
         self.jit_params: list = []   # stack of traced-param name sets
         self.jit_depth = 0           # count of enclosing jax.jit functions
         self.is_hot = any(rel.endswith(h) for h in HOT_PATH_FILES)
@@ -130,9 +144,12 @@ class _Lint(ast.NodeVisitor):
         self.jit_params.append(traced)
         self.param_use_stack.append((names, {}))
         saved_loop = self.loop_depth
+        saved_chunk = self.chunk_loop_depth
         self.loop_depth = 0
+        self.chunk_loop_depth = 0
         self.generic_visit(node)
         self.loop_depth = saved_loop
+        self.chunk_loop_depth = saved_chunk
         self.jit_params.pop()
         if jit_static is not None:
             self.jit_depth -= 1
@@ -147,8 +164,14 @@ class _Lint(ast.NodeVisitor):
     # -- loops --------------------------------------------------------------
 
     def visit_For(self, node):
+        is_chunk = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr in _CHUNK_ITER_FUNCS
+            for n in ast.walk(node.iter))
         self.loop_depth += 1
+        self.chunk_loop_depth += is_chunk
         self.generic_visit(node)
+        self.chunk_loop_depth -= is_chunk
         self.loop_depth -= 1
 
     def visit_While(self, node):
@@ -191,7 +214,37 @@ class _Lint(ast.NodeVisitor):
 
     # -- calls / attributes -------------------------------------------------
 
+    def _check_chunk_loop_sync(self, node) -> None:
+        """Flag host syncs inside a ``device_chunks()``/``padded_chunks()``
+        loop: per-chunk host decisions are the O(chunks) dispatch cost the
+        compiled streaming executor removes (engine/stream.py)."""
+        if not self.chunk_loop_depth:
+            return
+        f = node.func
+        what = None
+        if isinstance(f, ast.Attribute):
+            owner = f.value.id if isinstance(f.value, ast.Name) else None
+            if f.attr == "item" and not node.args:
+                what = ".item()"
+            elif owner in ("np", "numpy") and f.attr in _SYNC_NP_FUNCS:
+                what = f"np.{f.attr}()"
+            elif f.attr == "device_get":
+                what = "device_get()"
+            elif f.attr == "to_int" and not node.args:
+                what = ".to_int()"
+            elif f.attr in _ENGINE_SYNC_FUNCS:
+                what = f"{f.attr}()"
+        elif isinstance(f, ast.Name) and f.id in _ENGINE_SYNC_FUNCS:
+            what = f"{f.id}()"
+        if what:
+            self._emit("chunk-loop-host-sync", "warning",
+                       f"{what} inside a device_chunks() loop syncs once "
+                       "per chunk (O(chunks) round trips); keep the chunk "
+                       "pipeline device-resident or route it through the "
+                       "compiled streaming executor", node.lineno)
+
     def visit_Call(self, node):
+        self._check_chunk_loop_sync(node)
         f = node.func
         if isinstance(f, ast.Attribute):
             owner = f.value.id if isinstance(f.value, ast.Name) else None

@@ -140,6 +140,64 @@ class ReplayMismatch(RuntimeError):
     recording — the query is not replay-safe; callers fall back eager."""
 
 
+# --------------------------------------------------------------------------
+# stream-bounds mode: the compiled streaming executor (engine/stream.py)
+# traces ONE per-chunk program and replays it for every chunk of a >HBM
+# ChunkedTable, so the program must be CHUNK-INVARIANT — no host decision
+# may depend on a chunk's data. Inside a stream-bounds region:
+#   * host scalar syncs raise StreamSyncError (the executor falls back to
+#     the eager chunk loop — correctness never depends on streamability);
+#   * joins size their pair buckets from STATIC bounds instead of a
+#     data-dependent sizing sync, registering a device-side overflow
+#     predicate via stream_overflow() that the executor checks once at the
+#     pipeline's single materializing sync (overflow => rerun eager);
+#   * lazy compaction never takes the adaptive resolve (counts stay on
+#     device for the pipeline's whole life).
+# Host reads against NON-streamed inputs (dimension key maps/ranges) stay
+# legal: they are chunk-invariant and ride the replay log.
+# --------------------------------------------------------------------------
+
+
+class StreamSyncError(RuntimeError):
+    """A chunk-data-dependent host sync was reached inside a stream-bounds
+    region; the query's join graph is not streamable through the compiled
+    chunk pipeline."""
+
+
+def stream_bounds_on() -> bool:
+    return getattr(_sync_tls, "stream_bounds", False)
+
+
+class _StreamBoundsSession:
+    def __enter__(self):
+        self._prev = (stream_bounds_on(),
+                      getattr(_sync_tls, "stream_flags", None))
+        _sync_tls.stream_bounds = True
+        self.flags: list = []
+        _sync_tls.stream_flags = self.flags
+        return self
+
+    def __exit__(self, *exc):
+        _sync_tls.stream_bounds, _sync_tls.stream_flags = self._prev
+
+
+def stream_bounds():
+    """Context: execute with chunk-invariant (bound-derived) shape
+    decisions; ``.flags`` collects the device-side overflow predicates the
+    region registered."""
+    return _StreamBoundsSession()
+
+
+def stream_overflow(pred) -> None:
+    """Register a device bool scalar that is True when a bound-sized
+    bucket overflowed (rows silently dropped). The streaming executor ORs
+    every flag into its accumulated overflow bit; outside a stream-bounds
+    region this is a no-op."""
+    flags = getattr(_sync_tls, "stream_flags", None)
+    if flags is not None:
+        flags.append(pred)
+
+
 def replay_mode() -> str:
     return getattr(_sync_tls, "replay_mode", "off")
 
@@ -256,6 +314,9 @@ def timed_read(tag: str, fetch):
 
 def host_sync(value) -> int:
     """Read a device scalar on host, counting the sync."""
+    if stream_bounds_on():
+        raise StreamSyncError(
+            "host scalar sync inside a stream-bounds region")
 
     def fetch():
         add_syncs()
@@ -292,6 +353,11 @@ class DeviceCount:
         _pending_counts().append(self)
 
     def to_int(self) -> int:
+        if self._host is None and stream_bounds_on():
+            # a chunk-data-dependent count must never reach host inside
+            # the compiled per-chunk program (engine/stream.py)
+            raise StreamSyncError(
+                "DeviceCount resolution inside a stream-bounds region")
         if self._host is None:
             resolve_counts()
         if self._host is None:
@@ -464,7 +530,7 @@ def compact_table(table: DeviceTable, mask: jnp.ndarray,
     idx = jnp.nonzero(m, size=cap, fill_value=max(table.plen, 1))[0]
     n = DeviceCount(jnp.sum(m), min(count_bound(table.nrows), cap))
     out = take_padded(table, idx, n)
-    if cap > _LAZY_SHRINK_ROWS:
+    if cap > _LAZY_SHRINK_ROWS and not stream_bounds_on():
         # adaptive: past this bucket size the downstream sorts/segment ops a
         # fat bucket drags through cost more than one (batched) round trip,
         # so resolve now — the transfer still drains the whole pending batch
@@ -1206,6 +1272,12 @@ def _probe_candidates(left_keys, right_keys, null_safe=False,
     lo = jnp.searchsorted(rh_sorted, lh, side="left")
     hi = jnp.searchsorted(rh_sorted, lh, side="right")
     counts = hi - lo
+    if stream_bounds_on():
+        # chunk-invariant program: no data-dependent sizing sync. The
+        # caller sizes its pair bucket from static bounds and registers a
+        # device-side overflow flag (checked at the pipeline's single
+        # materializing sync).
+        return counts, lo, order, None
     total = host_sync(jnp.sum(counts))                 # host sync 1
     return counts, lo, order, total
 
@@ -1230,14 +1302,29 @@ def join_indices(left_keys, right_keys, how: str = "inner",
     counts, lo, order, total = probe if probe is not None else \
         _probe_candidates(left_keys, right_keys, null_safe,
                           n_left, n_right, l_excl, r_excl)
-    if total > 0:
-        cand = bucket_len(total)
+    if total is None or total > 0:
+        if total is None:
+            # stream-bounds join: the candidate total stays on device, so
+            # the pair bucket is sized from STATIC bounds (probe-side
+            # bucket x a power-of-two fanout allowance). A chunk whose
+            # true candidate count exceeds it would silently drop pairs,
+            # so the excess registers as a device-side overflow flag the
+            # streaming executor checks at its single materializing sync.
+            total_dev = jnp.sum(counts)
+            cand = min(bucket_len(count_bound(n_left)) * _STREAM_FANOUT,
+                       bucket_len(_PAIR_BUDGET))
+            stream_overflow(total_dev > cand)
+            pair_live = jnp.arange(cand) < total_dev
+            n_pairs_bound = cand
+        else:
+            cand = bucket_len(total)
+            pair_live = live_mask(cand, total)
+            n_pairs_bound = total
         l_idx = jnp.repeat(jnp.arange(plen_l), counts, total_repeat_length=cand)
         starts = jnp.cumsum(counts) - counts
         pos = jnp.arange(cand) - jnp.repeat(starts, counts, total_repeat_length=cand)
         r_pos = jnp.repeat(lo, counts, total_repeat_length=cand) + pos
         r_idx = jnp.take(order, jnp.clip(r_pos, 0, max(plen_r - 1, 0)))
-        pair_live = live_mask(cand, total)
         ok = _verify_pairs(l_idx, r_idx, left_keys, right_keys, null_safe)
         ok = ok & pair_live
         # NO pair-count sync: verified pairs compact to the prefix of the
@@ -1246,7 +1333,7 @@ def join_indices(left_keys, right_keys, how: str = "inner",
         # An outer join resolves it below — batched with the extra counts
         # into ONE transfer (DESIGN.md item 3) — because the concatenated
         # output layout needs host offsets; an inner join never syncs here.
-        n_pairs = DeviceCount(jnp.sum(ok), total)
+        n_pairs = DeviceCount(jnp.sum(ok), n_pairs_bound)
         keep = jnp.nonzero(ok, size=cand, fill_value=cand)[0]
         # out-of-range pads: point pad pairs past both inputs
         l_idx = jnp.take(l_idx, keep, mode="fill", fill_value=plen_l)
@@ -1572,6 +1659,13 @@ def _null_column_like(col: Column, n: int) -> Column:
 # ref: nds/power_run_gpu.template:29-37)
 _PAIR_BUDGET = int(os.environ.get("NDS_TPU_PAIR_BUDGET", str(1 << 22)))
 
+# stream-bounds pair-bucket fanout: inside the compiled chunk pipeline a
+# hash join cannot sync for its candidate total, so the bucket is the
+# probe side's bound times this power-of-two allowance (kept power-of-two
+# so bucket shapes stay canonical); overflow falls back to the eager loop
+_STREAM_FANOUT = _pow2_ceil(int(os.environ.get("NDS_TPU_STREAM_FANOUT",
+                                               "4")))
+
 
 @functools.partial(jax.jit, static_argnames=("cand",))
 def _span_pair_indices(counts, lo, order, s, e, cand):
@@ -1707,7 +1801,10 @@ def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
         probe = _probe_candidates(left_keys, right_keys,
                                   n_left=left.nrows, n_right=right.nrows,
                                   l_excl=l_excl, r_excl=r_excl)
-        if probe[3] > _PAIR_BUDGET:
+        # probe[3] is None under stream-bounds: the chunked (span-by-span)
+        # join syncs per span, so the streamed path always takes the
+        # bound-bucket monolithic arm below
+        if probe[3] is not None and probe[3] > _PAIR_BUDGET:
             return _chunked_inner_join(left, right, left_keys, right_keys,
                                        probe, residual_fn)
     l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx = join_indices(

@@ -233,10 +233,11 @@ class CompiledQuery:
                     isinstance(t.nrows, E.DeviceCount):
                 t.nrows = t.nrows.to_int()
         # argument universe: every DEVICE table in the catalog. Host-
-        # resident ChunkedTables are left out: a query that binds one
-        # fails the compile trace (missing from the rebuilt catalog) and
-        # is blacklisted to the eager chunk loop, while every other query
-        # in the same >HBM session stays replay-eligible.
+        # resident ChunkedTables are left out: a query that binds one is
+        # filtered upstream by record_eligible() and routed to the
+        # compiled streaming executor (engine/stream.py) instead, while
+        # every other query in the same >HBM session stays
+        # replay-eligible.
         self.arg_spec = []
         for tname in sorted(catalog):
             t = catalog[tname]
@@ -371,10 +372,51 @@ def out_template_of(table: DeviceTable):
             E.count_bound(table.nrows))
 
 
-def record_eligible(session) -> bool:
+def _binds_chunked(session, stmt) -> bool:
+    """True when any table reference in the statement resolves to a
+    host-resident ChunkedTable in the session catalog. Conservative on
+    shadowing: a CTE reusing a chunked table's name still counts (the
+    statement simply stays on the planner path, which handles it)."""
+    from nds_tpu.engine.table import ChunkedTable
+    from nds_tpu.sql import ast as A
+    chunked = {name for name, t in session.catalog.items()
+               if isinstance(t, ChunkedTable)}
+    if not chunked:
+        return False
+    found = False
+
+    def walk(x):
+        nonlocal found
+        if found:
+            return
+        if isinstance(x, A.TableRef) and x.name.lower() in chunked:
+            found = True
+            return
+        if hasattr(x, "__dataclass_fields__"):
+            for f in vars(x).values():
+                walk_any(f)
+
+    def walk_any(f):
+        if isinstance(f, (list, tuple)):
+            for y in f:
+                walk_any(y)
+        elif hasattr(f, "__dataclass_fields__"):
+            walk(f)
+    walk(stmt)
+    return found
+
+
+def record_eligible(session, stmt=None) -> bool:
     """Recording is attempted per QUERY, not per catalog: a session with
     >HBM ChunkedTables still replays every query that binds only device
-    tables (a query that does bind a chunked scan fails its compile trace
-    and is blacklisted to the eager chunk loop — see
-    ``CompiledQuery.compile``)."""
+    tables. A query that DOES bind a chunked scan is routed away from
+    whole-query record/replay up front — recording it would log one host
+    decision per chunk and the compile trace cannot rebuild a
+    host-resident table from jit arguments. Its streaming is compiled
+    one layer down instead: the planner's ``_stream_join_parts`` hands the
+    join graph to the chunk pipeline executor (engine/stream.py), which
+    applies the same record/replay machinery to ONE chunk-invariant
+    per-chunk program."""
+    if stmt is not None and _binds_chunked(session, stmt):
+        return False
     return True

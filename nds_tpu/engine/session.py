@@ -358,34 +358,43 @@ class Session:
                     "replayed query dispatch (one-off eager fallback)", exc)
         if key in self._replay_seen and key not in self._replay_blacklist \
                 and key not in self._replay_cache \
-                and self._replay_wanted(key) and R.record_eligible(self):
-            E.resolve_counts()   # stray pending counts must not enter the log
-            t0 = _time.perf_counter()
-            with E.recording() as log:
-                table = planner.query(stmt)
-            # block to completion so eager_s is a true wall, comparable to
-            # the blocked replay wall (async dispatch would otherwise
-            # under-count the eager side and mis-tune the eviction)
-            import jax as _jax
-            if table.columns:
-                _jax.block_until_ready(
-                    next(iter(table.columns.values())).data)
-            eager_s = _time.perf_counter() - t0
-            # deferred SQL runtime checks from the record pass must raise
-            # NOW: inside compile() they would be swallowed by the
-            # blacklist handler below and the error lost for good
-            E.flush_deferred_checks()
-            try:
-                cq = R.CompiledQuery(self, stmt, log,
-                                     R.out_template_of(table)).compile()
-                cq.scan_bytes = dict(planner.scanned)
-                cq.eager_s = eager_s
-                cq.strikes = 0
-                cq.first_run = True
-                self._replay_cache[key] = cq
-            except Exception:
+                and self._replay_wanted(key):
+            if not R.record_eligible(self, stmt):
+                # binds a >HBM chunked scan: whole-query record/replay
+                # never applies — its streaming is compiled one layer down
+                # by the chunk pipeline (engine/stream.py, via
+                # _stream_join_parts). Blacklisting stops replay_pending()
+                # from advertising a record pass that will never happen.
                 self._replay_blacklist.add(key)
-            return Result(table)
+            else:
+                E.resolve_counts()   # stray pending counts must not enter
+                t0 = _time.perf_counter()
+                with E.recording() as log:
+                    table = planner.query(stmt)
+                # block to completion so eager_s is a true wall, comparable
+                # to the blocked replay wall (async dispatch would
+                # otherwise under-count the eager side and mis-tune the
+                # eviction)
+                import jax as _jax
+                if table.columns:
+                    _jax.block_until_ready(
+                        next(iter(table.columns.values())).data)
+                eager_s = _time.perf_counter() - t0
+                # deferred SQL runtime checks from the record pass must
+                # raise NOW: inside compile() they would be swallowed by
+                # the blacklist handler below and the error lost for good
+                E.flush_deferred_checks()
+                try:
+                    cq = R.CompiledQuery(self, stmt, log,
+                                         R.out_template_of(table)).compile()
+                    cq.scan_bytes = dict(planner.scanned)
+                    cq.eager_s = eager_s
+                    cq.strikes = 0
+                    cq.first_run = True
+                    self._replay_cache[key] = cq
+                except Exception:
+                    self._replay_blacklist.add(key)
+                return Result(table)
         self._replay_seen.add(key)
         # first sight: count this query's eager host syncs — the signal
         # 'auto' mode gates recording on (fetch-time syncs land after the

@@ -1,0 +1,470 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Compiled streaming executor: sync-budgeted chunk pipeline for >HBM scans.
+
+The eager chunk loop (``Planner._stream_join_parts``) re-plans the join
+graph per chunk, and every chunk pays the per-chunk host syncs (join pair
+sizing, adaptive compaction) — at SF10 that put 73 of 91 queries past the
+<=6-sync budget the device-resident path holds (query37: 128 syncs). The
+fix is the same one whole-query replay (engine/replay.py) applies to
+device-resident queries, specialized to the streaming shape:
+
+1. RECORD — run the join graph ONCE, eagerly, over the first padded chunk
+   under ``ops.recording()`` + ``ops.stream_bounds()``. Stream-bounds mode
+   forbids any chunk-data-dependent host decision (``StreamSyncError`` =>
+   the query stays on the eager loop), so the only recorded host reads are
+   chunk-INVARIANT dimension-side plans (dense key maps, key ranges) —
+   which makes the log valid for every chunk, not just the recorded one.
+2. COMPILE — re-run the same planner code under ``jax.jit`` with the
+   chunk's device buffers (and every other part's columns) as arguments
+   and ``ops.replaying(log)`` serving the recorded reads. Because
+   ``ChunkedTable.padded_chunks`` pads every chunk (including the final
+   partial one) to one fixed power-of-two capacity with a uniform pytree
+   structure, the single traced program serves all chunks.
+3. DRIVE — loop the chunks through that one executable with
+   double-buffered host->device prefetch (chunk k+1 converts and uploads
+   while chunk k's compute is in flight — dispatch is asynchronous, so
+   issuing compute first overlaps the two), accumulating survivors into
+   donated on-device buffers with a device-side running row count.
+4. SYNC — one materializing host read at pipeline end fetches the
+   survivor count plus the overflow flag. Overflow (a bound-sized pair
+   bucket or the accumulator capacity ran out of room on some chunk) means
+   rows were dropped on device: the result is discarded and the query
+   re-runs through the eager loop, so streamability is only ever a
+   performance property, never a correctness one.
+
+The eager loop remains reachable as ``NDS_TPU_STREAM_EXEC=eager`` (escape
+hatch) and as the automatic fallback for graphs that are not
+chunk-invariant (outer-join extras, cartesians, subquery residuals).
+
+Env knobs: ``NDS_TPU_STREAM_EXEC`` (compiled|eager),
+``NDS_TPU_STREAM_ACC_ROWS`` (survivor accumulator row ceiling, default
+2^23), ``NDS_TPU_STREAM_FANOUT`` (ops.py: stream-mode join pair-bucket
+allowance, default 4).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+
+from nds_tpu.engine import ops as E
+from nds_tpu.engine.column import Column, slice_col_prefix
+from nds_tpu.engine.table import DeviceTable
+from nds_tpu.listener import record_stream_event
+
+log = logging.getLogger(__name__)
+
+# survivor-accumulator row ceiling: the device-resident budget for rows the
+# pipeline may keep across ALL chunks. Exceeding it sets the overflow flag
+# and the query re-runs eagerly — the knob trades HBM headroom against
+# streamed coverage.
+_ACC_ROWS = int(os.environ.get("NDS_TPU_STREAM_ACC_ROWS", str(1 << 23)))
+
+# compiled pipelines are cached across statements (a Power Run executes
+# each query text 2-4 times); bounded FIFO, identity-validated on hit.
+# Mutations take the lock: concurrent Throughput streams share the cache.
+_PIPELINE_CACHE: dict = {}
+_PIPELINE_MAX = 64
+_PIPELINE_LOCK = threading.Lock()
+
+
+class _NotStreamable(Exception):
+    """The recorded join graph made a chunk-data-dependent host decision
+    (or its trace diverged); the caller falls back to the eager loop."""
+
+
+def _restore_counts(snapshot, checks_snapshot):
+    """Drop DeviceCounts/deferred checks created by a record or trace
+    attempt: their values belong to a discarded execution, and left in the
+    pending list they would cost (or poison) a later batched resolve."""
+    lst = E._pending_counts()
+    lst[:] = [c for c in lst if any(c is s for s in snapshot)]
+    E._sync_tls.checks = [
+        (c, f) for c, f in (getattr(E._sync_tls, "checks", None) or [])
+        if any(c is s for s in checks_snapshot)]
+
+
+def _flatten_part(part: DeviceTable):
+    """(spec, flat) for one non-streamed part: spec is static metadata
+    (names, kinds, dictionaries, valid presence, logical count, physical
+    length), flat the device buffers in spec order."""
+    spec, flat = [], []
+    nrows = E.count_int(part.nrows)   # resolved up front by the caller
+    for name in part.column_names:
+        c = part[name]
+        spec.append((name, c.kind, c.dict_values, c.valid is not None))
+        flat.append(c.data)
+        if c.valid is not None:
+            flat.append(c.valid)
+    return (tuple(spec), nrows, part.plen), flat
+
+
+def _rebuild_part(spec, flat):
+    (cols_spec, nrows, plen) = spec
+    cols, i = {}, 0
+    for name, kind, dv, has_valid in cols_spec:
+        data = flat[i]
+        i += 1
+        valid = None
+        if has_valid:
+            valid = flat[i]
+            i += 1
+        cols[name] = Column(kind, data, valid, dv)
+    return DeviceTable(cols, nrows, plen=plen)
+
+
+def _chunk_signature(chunk: DeviceTable, alias: str):
+    """Static chunk metadata: aliased names (the per-chunk program sees the
+    chunk as the planner's FROM-alias binding), kinds, dictionaries."""
+    spec = []
+    for name in chunk.column_names:
+        c = chunk[name]
+        aliased = f"{alias.lower()}.{name.split('.')[-1].lower()}"
+        spec.append((aliased, c.kind, c.dict_values))
+    return tuple(spec)
+
+
+class StreamPipeline:
+    """One compiled per-chunk program plus the metadata to drive it."""
+
+    def __init__(self, chunk_spec, chunk_cap, part_specs, keep, log_entries,
+                 operands, out_template, acc_cap, part_refs):
+        self.chunk_spec = chunk_spec      # ((aliased name, kind, dict), ...)
+        self.chunk_cap = chunk_cap
+        self.part_specs = part_specs      # specs of non-streamed parts
+        self.keep = keep
+        self.log = log_entries
+        self.operands = operands
+        self.out_template = out_template  # (names, kinds, dicts, valided)
+        self.acc_cap = acc_cap
+        # weakrefs to the part buffers, compared by identity on cache hit:
+        # a dead ref or different object is a miss (bare id() ints could
+        # collide after address reuse), and weakrefs don't pin dropped
+        # tables' device memory for the cache entry's lifetime
+        self.part_refs = part_refs
+        self.jitted = None
+
+    # ------------------------------------------------------------- compile
+
+    def compile(self, join_preds, where_conjuncts, sources):
+        from nds_tpu.sql.planner import Planner
+        chunk_spec, chunk_cap = self.chunk_spec, self.chunk_cap
+        part_specs, keep = self.part_specs, self.keep
+        rec_log, operands = self.log, self.operands
+        names, kinds, dicts, valided, dtypes = self.out_template
+        acc_cap = self.acc_cap
+        base_sources = list(sources)
+
+        def traced(chunk_flat, n_dev, parts_flat, ops_flat, acc):
+            acc_datas, acc_valids, acc_n, acc_ovf = acc
+            cols, i = {}, 0
+            for (aname, kind, dv) in chunk_spec:
+                cols[aname] = Column(kind, chunk_flat[i], chunk_flat[i + 1],
+                                     dv)
+                i += 2
+            chunk = DeviceTable(cols, E.DeviceCount(n_dev, chunk_cap),
+                                plen=chunk_cap)
+            sub, pi = [], 0
+            for j in range(len(part_specs) + 1):
+                if j == keep:
+                    sub.append(chunk)
+                    continue
+                sub.append(_rebuild_part(part_specs[pi], parts_flat[pi]))
+                pi += 1
+            # a fresh planner with an EMPTY catalog: the per-chunk program
+            # must close over no device-resident state (a cached pipeline
+            # would pin it for process lifetime); any path that needs the
+            # catalog (subquery residuals) fails this trace and the query
+            # stays on the eager loop
+            pl = Planner({}, base_tables=set())
+            with E.replaying(rec_log, ops_flat):
+                with E.stream_bounds() as sb:
+                    out = pl._join_parts(sub, list(join_preds),
+                                         list(where_conjuncts),
+                                         list(base_sources))
+                    flags = list(sb.flags)
+            if list(out.column_names) != list(names):
+                raise E.ReplayMismatch(
+                    "streamed trace produced a different output schema "
+                    "than the recording")
+            out_n = E.count_arr(out.nrows)
+            live = jnp.arange(out.plen) < out_n
+            pos = jnp.where(live, acc_n + jnp.arange(out.plen), acc_cap)
+            new_datas, new_valids = [], []
+            for j, n in enumerate(names):
+                c = out[n]
+                new_datas.append(
+                    acc_datas[j].at[pos].set(c.data, mode="drop"))
+                if valided[j]:
+                    new_valids.append(
+                        acc_valids[j].at[pos].set(c.valid_mask(),
+                                                  mode="drop"))
+                else:
+                    new_valids.append(acc_valids[j])
+            new_n = acc_n + out_n
+            ovf = acc_ovf | (new_n > acc_cap)
+            for f in flags:
+                ovf = ovf | f
+            return tuple(new_datas), tuple(new_valids), new_n, ovf
+
+        # donate the accumulators: the pipeline's working set stays
+        # (chunk in flight) + (chunk uploading) + ONE accumulator copy
+        self.jitted = jax.jit(traced, donate_argnums=(4,))
+        return self
+
+    # ---------------------------------------------------------------- run
+
+    def _flatten_chunk(self, chunk: DeviceTable):
+        flat = []
+        for name in chunk.column_names:
+            c = chunk[name]
+            flat.append(c.data)
+            flat.append(c.valid)
+        return tuple(flat)
+
+    def init_acc(self):
+        names, kinds, dicts, valided, dtypes = self.out_template
+        datas, valids = [], []
+        for j, dtype in enumerate(dtypes):
+            datas.append(jnp.zeros(self.acc_cap, dtype=dtype))
+            valids.append(jnp.zeros(self.acc_cap, dtype=bool)
+                          if valided[j] else jnp.zeros((), dtype=bool))
+        return (tuple(datas), tuple(valids),
+                jnp.asarray(0, dtype=jnp.int64), jnp.asarray(False))
+
+    def run(self, chunks, first_chunk, parts_flat):
+        """Drive every chunk through the compiled program; returns the
+        survivor DeviceTable or None on overflow (caller re-runs eagerly).
+        ``chunks`` continues AFTER ``first_chunk`` (already converted)."""
+        acc = self.init_acc()
+        cur = first_chunk
+        n_chunks = 0
+        while cur is not None:
+            n_dev = jnp.asarray(E.count_int(cur.nrows), dtype=jnp.int64)
+            # asynchronous dispatch: the compiled call returns immediately,
+            # so the NEXT chunk's arrow->device conversion (host slice +
+            # upload) below overlaps this chunk's device compute — the
+            # double-buffered prefetch
+            acc = self.jitted(self._flatten_chunk(cur), n_dev, parts_flat,
+                              self.operands, acc)
+            n_chunks += 1
+            cur = next(chunks, None)
+        datas, valids, n_dev, ovf = acc
+
+        def fetch():
+            total, overflowed = jax.device_get([n_dev, ovf])
+            return int(total), bool(overflowed)
+
+        # THE one materializing sync of the pipeline
+        total, overflowed = E.timed_read("stream_final", fetch)
+        if overflowed:
+            return None, n_chunks
+        names, kinds, dicts, valided, dtypes = self.out_template
+        cap = E.bucket_len(total)
+        cols = {}
+        for j, n in enumerate(names):
+            col = Column(kinds[j], datas[j],
+                         valids[j] if valided[j] else None, dicts[j])
+            cols[n] = slice_col_prefix(col, cap) if cap < self.acc_cap \
+                else col
+        return DeviceTable(cols, total, plen=min(cap, self.acc_cap)), \
+            n_chunks
+
+
+def _weak(x):
+    """weakref.ref when the buffer supports it; a strong closure otherwise
+    (plain ndarrays aren't weakref-able) — callers just call the ref."""
+    try:
+        return weakref.ref(x)
+    except TypeError:
+        return lambda obj=x: obj
+
+
+def _dicts_equal(a, b) -> bool:
+    import numpy as np
+    if a is None or b is None:
+        return a is b
+    return a is b or np.array_equal(a, b)
+
+
+def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
+               part_infos, chunk_spec, chunk_cap):
+    from nds_tpu.sql.parser import expr_key
+    return (
+        tuple(expr_key(c) for c in join_preds),
+        tuple(expr_key(c) for c in where_conjuncts),
+        keep, tuple(sources), alias.lower(), chunk_cap,
+        tuple((n, k) for (n, k, _dv) in chunk_spec),
+        tuple(((tuple((cn, ck, hv) for (cn, ck, _dv, hv) in spec[0]),
+                spec[1], spec[2]))
+              for (spec, _flat) in part_infos),
+    )
+
+
+def _cache_hit(key, chunk_spec, part_infos):
+    pipe = _PIPELINE_CACHE.get(key)
+    if pipe is None:
+        return None
+    # identity-validate part buffers (a maintenance refresh swaps them:
+    # the recorded dimension-side host reads would be stale) and
+    # content-validate chunk dictionaries (a re-registered streamed table
+    # re-encodes; same shapes, different value tables). A stale entry can
+    # never hit again — evict it now rather than waiting for FIFO churn.
+    flat_now = [x for (_spec, flat) in part_infos for x in flat]
+    then = [r() for r in pipe.part_refs]
+    stale = len(flat_now) != len(then) or \
+        any(b is None or a is not b for a, b in zip(flat_now, then)) or \
+        any(not _dicts_equal(dv_now, dv_then)
+            for (_, _, dv_now), (_, _, dv_then)
+            in zip(chunk_spec, pipe.chunk_spec))
+    if stale:
+        with _PIPELINE_LOCK:
+            if _PIPELINE_CACHE.get(key) is pipe:
+                _PIPELINE_CACHE.pop(key, None)
+        return None
+    return pipe
+
+
+def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
+                   sources):
+    """Execute a join graph whose ``keep``-th part is a ``_StreamedScan``
+    through the compiled chunk pipeline. Returns ``(table, None)`` on
+    success, or ``(None, reason)`` when the graph is not streamable /
+    overflowed — the caller (``Planner._stream_join_parts``) falls back
+    to the eager chunk loop and records the eager StreamEvent AFTER that
+    loop, so its syncs cover the whole fallback path, not just the failed
+    compile attempt. A ``(None, None)`` return means fall back silently
+    (no event)."""
+    if E.replay_mode() != "off":
+        # never nest inside whole-query record/replay: the pipeline's own
+        # recording would interleave with the outer log
+        return None, None
+    scan = parts[keep]
+    chunked, alias = scan.chunked, scan.alias
+    syncs0 = E.sync_count()
+
+    # resolve every non-streamed part's count up front (one batched
+    # transfer, usually free): part counts are per-statement constants of
+    # the compiled program
+    E.resolve_counts()
+    part_infos = []
+    for i, p in enumerate(parts):
+        if i == keep:
+            continue
+        part_infos.append(_flatten_part(p))
+    # the chunk slot must never be the dimension side of a PK-gather plan:
+    # that plan fetches the dim side's key ranges on host, which would
+    # bake CHUNK data into the chunk-invariant program
+    masked_sources = list(sources)
+    masked_sources[keep] = None
+
+    chunk_iter = chunked.padded_chunks()
+    first = next(chunk_iter)
+    chunk_spec = _chunk_signature(first, alias)
+    chunk_cap = chunked.chunk_cap
+    n_chunks = chunked.num_chunks()
+
+    key = None
+    try:
+        key = _cache_key(alias, keep, join_preds, where_conjuncts,
+                         masked_sources, part_infos, chunk_spec, chunk_cap)
+        pipe = _cache_hit(key, chunk_spec, part_infos)
+    except Exception:
+        pipe = None                      # unkeyable statement: no cache
+    parts_flat = tuple(tuple(flat) for (_spec, flat) in part_infos)
+
+    if pipe is None:
+        pipe = _build_pipeline(planner, parts, keep, alias, join_preds,
+                               where_conjuncts, masked_sources, part_infos,
+                               first, chunk_spec, chunk_cap, n_chunks)
+        if pipe is None:
+            return None, "not chunk-invariant"
+        if key is not None:
+            with _PIPELINE_LOCK:
+                while len(_PIPELINE_CACHE) >= _PIPELINE_MAX:
+                    _PIPELINE_CACHE.pop(next(iter(_PIPELINE_CACHE)))
+                _PIPELINE_CACHE[key] = pipe
+
+    snapshot = list(E._pending_counts())
+    checks_snapshot = [c for c, _f in
+                       (getattr(E._sync_tls, "checks", None) or [])]
+    try:
+        out, ran = pipe.run(chunk_iter, first, parts_flat)
+        # tracing the first call replays planner code that registers
+        # DeviceCounts/deferred checks holding TRACER values; they belong
+        # to the trace, not this execution — drop them before any
+        # downstream resolve_counts() would device_get them
+        _restore_counts(snapshot, checks_snapshot)
+    except (E.ReplayMismatch, E.StreamSyncError, ValueError, TypeError,
+            NotImplementedError, jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError,
+            jax.errors.TracerBoolConversionError) as exc:
+        # first-call trace divergence: unstreamable after all
+        _restore_counts(snapshot, checks_snapshot)
+        with _PIPELINE_LOCK:
+            _PIPELINE_CACHE.pop(key, None)
+        log.info("streamed pipeline fell back to eager: %s", exc)
+        return None, f"trace diverged: {exc}"
+    if out is None:
+        # device-side overflow: rows were dropped, rerun eagerly. Keep the
+        # compiled program — other statements over smaller data may fit.
+        log.info("streamed pipeline overflowed its bound buckets; "
+                 "re-running %s eagerly", alias)
+        return None, "bound-bucket overflow"
+    record_stream_event(alias, ran, E.sync_count() - syncs0, "compiled")
+    return out, None
+
+
+def _build_pipeline(planner, parts, keep, alias, join_preds,
+                    where_conjuncts, masked_sources, part_infos, first,
+                    chunk_spec, chunk_cap, n_chunks):
+    """RECORD the per-chunk join graph on the first padded chunk and
+    compile the chunk-invariant program; None when not streamable."""
+    from nds_tpu.engine.replay import _lift_log
+    snapshot = list(E._pending_counts())
+    checks_snapshot = [c for c, _f in
+                       (getattr(E._sync_tls, "checks", None) or [])]
+    sub = list(parts)
+    aliased = planner._alias_table(first, alias)
+    sub[keep] = DeviceTable(
+        aliased.columns,
+        E.DeviceCount(jnp.asarray(E.count_int(first.nrows),
+                                  dtype=jnp.int64), chunk_cap),
+        plen=chunk_cap)
+    pi = 0
+    for i in range(len(parts)):
+        if i == keep:
+            continue
+        sub[i] = _rebuild_part(part_infos[pi][0], part_infos[pi][1])
+        pi += 1
+    try:
+        with E.recording() as rec_log:
+            with E.stream_bounds():
+                out0 = planner._join_parts(sub, list(join_preds),
+                                           list(where_conjuncts),
+                                           list(masked_sources))
+    except E.StreamSyncError as exc:
+        log.info("streamed scan %s not chunk-invariant: %s", alias, exc)
+        return None
+    finally:
+        _restore_counts(snapshot, checks_snapshot)
+    names = list(out0.column_names)
+    template = (names,
+                [out0[n].kind for n in names],
+                [out0[n].dict_values for n in names],
+                [out0[n].valid is not None for n in names],
+                [out0[n].data.dtype for n in names])
+    acc_cap = E.bucket_len(
+        max(min(n_chunks * out0.plen, _ACC_ROWS), out0.plen))
+    lifted, operands = _lift_log(list(rec_log))
+    pipe = StreamPipeline(
+        chunk_spec, chunk_cap,
+        tuple(spec for (spec, _flat) in part_infos), keep, lifted,
+        tuple(operands), template, acc_cap,
+        [_weak(x) for (_spec, flat) in part_infos for x in flat])
+    return pipe.compile(join_preds, where_conjuncts, masked_sources)

@@ -103,6 +103,10 @@ class ChunkedTable:
         self.canonical_types = canonical_types or {}
         self.chunk_rows = int(chunk_rows or os.environ.get(
             "NDS_TPU_STREAM_CHUNK_ROWS", str(1 << 22)))
+        # unified per-column string encodings for the compiled streaming
+        # executor (built lazily by padded_chunks; shared across select()
+        # views, since a projection never changes column contents)
+        self._str_store: dict = {}
 
     @property
     def nrows(self) -> int:
@@ -117,8 +121,10 @@ class ChunkedTable:
         return list(self.arrow.column_names)
 
     def select(self, names) -> "ChunkedTable":
-        return ChunkedTable(self.arrow.select(names), self.canonical_types,
-                            self.chunk_rows)
+        out = ChunkedTable(self.arrow.select(names), self.canonical_types,
+                           self.chunk_rows)
+        out._str_store = self._str_store
+        return out
 
     def device_chunks(self):
         """Yield DeviceTable chunks (at least one, possibly empty, so the
@@ -131,6 +137,101 @@ class ChunkedTable:
         for s in range(0, n, self.chunk_rows):
             sl = self.arrow.slice(s, min(self.chunk_rows, n - s))
             yield from_arrow(sl.combine_chunks(), self.canonical_types)
+
+    @property
+    def chunk_cap(self) -> int:
+        """Uniform physical capacity of every padded chunk."""
+        from nds_tpu.engine.ops import bucket_len
+        return bucket_len(self.chunk_rows)
+
+    def num_chunks(self) -> int:
+        n = self.arrow.num_rows
+        return max(1, -(-n // self.chunk_rows))
+
+    def _string_encodings(self) -> dict:
+        """name -> (int32 codes, shared value table, valid | None) for every
+        string column, encoded ONCE against a single whole-table dictionary.
+
+        The compiled streaming executor runs one traced program over every
+        chunk; dictionary codes are device DATA in that program while the
+        value table is host metadata baked into the trace, so all chunks
+        must share one dictionary (per-chunk encodings would make the same
+        code mean different strings chunk to chunk). The value table is
+        also handed out as the SAME host object for every chunk, keeping
+        identity-keyed caches (rank maps, expression fusion) warm. Cached
+        per column in a store shared with select() views."""
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.compute as pc
+        from nds_tpu import types as _t
+        enc: dict = {}
+        for name in self.arrow.column_names:
+            hit = self._str_store.get(name)
+            if hit is not None:
+                enc[name] = hit
+                continue
+            ct = self.canonical_types.get(name) or _t.arrow_to_canonical(
+                self.arrow.schema.field(name).type)
+            if _t.device_kind(ct) != "str":
+                continue
+            col = self.arrow[name].combine_chunks()
+            if not pa.types.is_dictionary(col.type):
+                col = pc.dictionary_encode(col)
+            codes = np.asarray(
+                pc.fill_null(col.indices, 0).to_numpy(zero_copy_only=False),
+                dtype=np.int32)
+            values = np.asarray(col.dictionary.to_pylist(), dtype=object)
+            if values.size == 0:
+                values = np.asarray([""], dtype=object)
+            valid = None
+            if col.null_count:
+                valid = ~np.asarray(pc.is_null(col).to_numpy(
+                    zero_copy_only=False))
+            enc[name] = self._str_store[name] = (codes, values, valid)
+        return enc
+
+    def padded_chunks(self):
+        """Yield DeviceTable chunks at ONE uniform physical capacity
+        (``chunk_cap``), the final partial chunk zero-padded up to it, with
+        every column carrying an explicit validity mask (False past the
+        live prefix). Chunk k then differs from chunk j only in buffer
+        CONTENTS — same shapes, same pytree structure, same dictionaries —
+        which is what lets the compiled streaming executor drive every
+        chunk through a single traced program (engine/stream.py)."""
+        import jax.numpy as jnp
+        import numpy as np
+        from nds_tpu import types as _t
+        from nds_tpu.engine.column import Column, from_arrow_array
+        cap = self.chunk_cap
+        n = self.arrow.num_rows
+        strings = self._string_encodings()
+        for s in (range(0, n, self.chunk_rows) if n else (0,)):
+            live = min(self.chunk_rows, n - s) if n else 0
+            live_np = np.arange(cap) < live
+            sl = self.arrow.slice(s, live)
+            cols = {}
+            for name in self.arrow.column_names:
+                if name in strings:
+                    codes, values, valid = strings[name]
+                    data = np.zeros(cap, dtype=np.int32)
+                    data[:live] = codes[s:s + live]
+                    v = live_np if valid is None else \
+                        live_np & np.concatenate(
+                            [valid[s:s + live],
+                             np.zeros(cap - live, dtype=bool)])
+                    cols[name] = Column("str", jnp.asarray(data),
+                                        jnp.asarray(v), values)
+                    continue
+                ct = self.canonical_types.get(name) or _t.arrow_to_canonical(
+                    self.arrow.schema.field(name).type)
+                c = from_arrow_array(sl[name], ct, cap)
+                # canonical validity structure: a chunk without nulls must
+                # present the same pytree as a sibling with them, or every
+                # null-pattern change would retrace the compiled program
+                v = jnp.asarray(live_np) if c.valid is None else \
+                    c.valid & jnp.asarray(live_np)
+                cols[name] = Column(c.kind, c.data, v, c.dict_values)
+            yield DeviceTable(cols, live, plen=cap)
 
     def materialize(self) -> DeviceTable:
         from nds_tpu.engine.column import from_arrow

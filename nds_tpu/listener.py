@@ -97,6 +97,45 @@ class Manager:
             l.notify(where, reason, fatal)
 
 
+@dataclass
+class StreamEvent:
+    """Accounting record for one >HBM streamed scan execution: which path
+    served it (the compiled chunk pipeline or the eager chunk loop), how
+    many chunks flowed, and how many host syncs the pipeline charged —
+    the number the streamed-path sync budget (tests/test_synccount.py)
+    pins. Drained per query by the drivers (power.py / bench.py) into the
+    per-query summaries, next to the plain sync counters."""
+
+    where: str                 # e.g. "store_sales"
+    chunks: int
+    syncs: int                 # host syncs charged while the scan executed
+    path: str                  # "compiled" | "eager"
+    reason: str = ""           # why the compiled path was not taken
+
+
+_stream_tls = threading.local()
+
+
+def record_stream_event(where: str, chunks: int, syncs: int, path: str,
+                        reason: str = "") -> None:
+    """Engine-side hook (engine/stream.py, sql/planner.py): record how a
+    streamed scan executed. Thread-scoped like the sync counters, so
+    concurrent Throughput streams account their own pipelines."""
+    lst = getattr(_stream_tls, "events", None)
+    if lst is None:
+        lst = _stream_tls.events = []
+    if len(lst) >= 1000:            # diagnostics, never unbounded
+        lst.pop(0)
+    lst.append(StreamEvent(where, chunks, syncs, path, reason))
+
+
+def drain_stream_events() -> list:
+    """Return and clear the calling thread's streamed-scan events."""
+    lst = getattr(_stream_tls, "events", None) or []
+    _stream_tls.events = []
+    return lst
+
+
 def report_task_failure(where: str, exc: BaseException | str,
                         fatal: bool = False) -> None:
     """Engine-side hook: call on any retried partition task, capacity

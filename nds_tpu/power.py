@@ -197,7 +197,9 @@ def run_query_stream(input_prefix: str,
             trace_ctx = _prof.trace(os.path.join(profile_folder, query_name))
             trace_ctx.__enter__()
         from nds_tpu.engine import ops as _ops
+        from nds_tpu.listener import drain_stream_events as _drain_stream
         _ops.enable_compile_meter()
+        _drain_stream()          # setup leftovers must not charge query 1
         syncs_before = _ops.sync_count()
         wait_before = _ops.sync_wait_ns()
         fetch_before = _ops.fetch_bytes()
@@ -228,6 +230,18 @@ def run_query_stream(input_prefix: str,
         sync_ms = (_ops.sync_wait_ns() - wait_before) / 1e6
         q_report.summary["syncWaitMs"] = round(sync_ms, 3)
         q_report.summary["fetchBytes"] = _ops.fetch_bytes() - fetch_before
+        # >HBM streamed scans (engine/stream.py): which path served each
+        # ChunkedTable-bound scan — the compiled chunk pipeline or the
+        # eager chunk loop — with chunk/sync counts, so a query blowing
+        # the streamed sync budget names the scan (and fallback reason)
+        # that charged it
+        stream_events = _drain_stream()
+        if stream_events:
+            q_report.summary["streamedScans"] = [
+                {"table": e.where, "chunks": e.chunks, "syncs": e.syncs,
+                 "path": e.path,
+                 **({"reason": e.reason} if e.reason else {})}
+                for e in stream_events]
         # compile-vs-execute split (round-4 verdict missing #3): compileMs
         # is XLA backend compilation charged to this query's wall (zero on
         # a warm shape universe / persistent-cache hit); the remainder is

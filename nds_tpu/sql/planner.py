@@ -1009,12 +1009,21 @@ class Planner:
     def _stream_join_parts(self, parts, join_preds, where_conjuncts,
                            sources):
         """Streamed execution of a join graph containing >HBM scans: bind
-        the largest streamed part's device chunks one at a time, run the
-        NORMAL join graph per chunk (pushed-down filters and joins shrink
-        the chunk before anything is kept), and concatenate the survivors.
+        the largest streamed part's device chunks one at a time and run
+        the join graph per chunk (pushed-down filters and joins shrink the
+        chunk before anything is kept), keeping the survivor union.
         Downstream aggregation runs on the union, which is correct because
         joins and filters distribute over row-wise union. Other streamed
-        parts materialize whole (one streaming axis per graph)."""
+        parts materialize whole (one streaming axis per graph).
+
+        Default path: the COMPILED chunk pipeline (engine/stream.py) —
+        one traced per-chunk program driven over every padded chunk with
+        prefetch, on-device survivor accumulation and a single
+        materializing sync, holding streamed queries to the same host-sync
+        budget as device-resident ones (tests/test_synccount.py). The
+        per-chunk eager loop below survives as the automatic fallback for
+        graphs that are not chunk-invariant and as the explicit
+        ``NDS_TPU_STREAM_EXEC=eager`` escape hatch."""
         streamed = [i for i, p in enumerate(parts)
                     if isinstance(p, _StreamedScan)]
         keep = max(streamed, key=lambda i: parts[i].nbytes)
@@ -1022,15 +1031,37 @@ class Planner:
         for i in streamed:
             if i != keep:
                 parts[i] = parts[i].bind_whole(self)
+        syncs0 = E.sync_count()
+        reason = None
+        if os.environ.get("NDS_TPU_STREAM_EXEC",
+                          "compiled").lower() != "eager":
+            from nds_tpu.engine.stream import stream_execute
+            got, reason = stream_execute(self, parts, keep, join_preds,
+                                         where_conjuncts, list(sources))
+            if got is not None:
+                return got
+        else:
+            reason = "NDS_TPU_STREAM_EXEC=eager"
         outs = []
+        n_chunks = 0
         for chunk in parts[keep].device_chunks(self):
+            n_chunks += 1
             sub = list(parts)
             sub[keep] = chunk
             out = self._join_parts(sub, join_preds, where_conjuncts,
                                    list(sources))
             if E.count_bound(out.nrows) or not outs:
                 outs.append(out)
-        return E.concat_tables(outs) if len(outs) > 1 else outs[0]
+        result = E.concat_tables(outs) if len(outs) > 1 else outs[0]
+        if reason is not None:
+            # recorded AFTER the loop: the event's syncs charge the whole
+            # eager path (failed compile attempt + per-chunk loop), which
+            # is exactly the cost streamedScans exists to expose. reason
+            # None = replay-nested fallback, accounted by the outer pass.
+            from nds_tpu.listener import record_stream_event
+            record_stream_event(parts[keep].alias, n_chunks,
+                                E.sync_count() - syncs0, "eager", reason)
+        return result
 
     def _join_parts(self, parts, join_preds, where_conjuncts, sources=None):
         """Join-graph execution: push single-table predicates down, then join

@@ -397,6 +397,44 @@ def test_jax_lint_cache_setdefault_counts_as_write(tmp_path):
     assert not fs, "\n".join(str(f) for f in fs)
 
 
+def test_jax_lint_chunk_loop_host_sync(tmp_path):
+    # in ANY module (not just hot-path files): a sync per streamed chunk
+    # is the O(chunks) cost the compiled executor removes
+    fs = lint_snippet(tmp_path, """
+        import numpy as np
+        from nds_tpu.engine import ops as E
+        def eager(table, parts):
+            outs = []
+            for chunk in table.device_chunks():
+                n = E.count_int(chunk.nrows)
+                outs.append(np.asarray(chunk.data))
+                m = chunk.nrows.to_int()
+                k = chunk.total.item()
+            for chunk in table.padded_chunks():
+                E.resolve_counts()
+            return outs
+    """, rel="nds_tpu/report.py")
+    assert [f.rule for f in fs] == ["chunk-loop-host-sync"] * 5
+    assert all(f.severity == "warning" for f in fs)
+
+
+def test_jax_lint_chunk_loop_scoping(tmp_path):
+    # the same syncs OUTSIDE a chunk loop (or in a plain loop) are not
+    # this rule's findings; device-resident chunk work is clean
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import ops as E
+        def fine(table, items):
+            n = E.count_int(table.nrows)      # not in a loop
+            for x in items:                   # not a chunk loop
+                y = E.count_int(x.nrows)
+            outs = []
+            for chunk in table.device_chunks():
+                outs.append(chunk)            # sync-free chunk loop
+            return outs
+    """, rel="nds_tpu/report.py")
+    assert not [f for f in fs if f.rule == "chunk-loop-host-sync"]
+
+
 def test_jax_lint_suppression_honored(tmp_path):
     fs = lint_snippet(tmp_path, """
         def drain(cols):

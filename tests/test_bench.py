@@ -115,10 +115,24 @@ def test_load_resume_prepopulates_and_skips(tmp_path):
         f.write("not json\n")                        # tolerated garbage
         f.write(json.dumps({"name": "query9", "error": "boom"}) + "\n")
     times, perf = {}, {}
-    bench.load_resume(str(p), times, perf)
+    assert bench.load_resume(str(p), times, perf) is None
     assert times == {"query3": 1234.5}
     assert perf["query3"]["compileS"] == 7.7
     assert "query9" not in times                     # errors not resumed
+
+
+def test_load_resume_recovers_platform(tmp_path):
+    """A rerun satisfied entirely from the resume file never starts a
+    child — load_resume must return the original campaign's platform meta
+    line so PERF.md's provenance doesn't regress to 'unknown'."""
+    p = tmp_path / "results.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"name": "query1", "ms": 10.0,
+                            "hostSyncs": 1}) + "\n")
+        f.write(json.dumps({"platform": "axon"}) + "\n")
+    times, perf = {}, {}
+    assert bench.load_resume(str(p), times, perf) == "axon"
+    assert times == {"query1": 10.0}
 
 
 def test_bench_queries_names_match_stream_names():
@@ -141,6 +155,65 @@ def test_first_partial_run_seeds_baseline(tmp_path):
     assert json.load(open(f))["n_queries"] == 102   # what was measured
     vs2 = bench.resolve_baseline(str(f), _times(50, 102), 103)
     assert abs(vs2 - 2.0) < 1e-9
+
+
+def test_setup_timeout_circuit_breaker(monkeypatch, capsys):
+    """Two consecutive child-setup failures must trip the breaker: stop
+    burning budget and emit a LABELED partial artifact (BENCH_r05 spent
+    its entire 3000s on six 300s setup timeouts, yielding n_queries: 0
+    with no indication why)."""
+    starts = []
+
+    class DeadChild:
+        def __init__(self):
+            self.proc = None
+
+        def alive(self):
+            return False
+
+        def start(self, deadline_left):
+            starts.append(deadline_left)
+            return None                         # setup timeout / dead child
+
+        def stop(self):
+            pass
+
+    monkeypatch.setattr(bench, "ChildServer", DeadChild)
+    monkeypatch.setattr(bench, "ensure_data", lambda: None)
+    monkeypatch.setattr(bench, "bench_queries",
+                        lambda: [("query1", "select 1")])
+    monkeypatch.setattr(bench, "_emitted", False)
+    import time as _time
+    with pytest.raises(SystemExit):
+        bench.run_parent(_time.perf_counter())
+    assert len(starts) == 2, "breaker must trip after exactly 2 failures"
+    out = capsys.readouterr()
+    msg = json.loads(out.out.strip().splitlines()[-1])
+    assert msg["n_queries"] == 0
+    assert msg["aborted"] == "child-setup-failure"
+    assert "failing fast" in out.err
+
+
+def test_write_perf_stamps_platform_and_streamed(tmp_path, monkeypatch):
+    """PERF.md header carries the measured jax platform (provenance) and
+    the streamed->HBM scan path aggregate when any query streamed."""
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    times = {"query1": 100.0, "query2": 50.0}
+    perf = {
+        "query1": {"hostSyncs": 2, "syncWaitMs": 5.0,
+                   "streamedScans": [
+                       {"table": "store_sales", "chunks": 12, "syncs": 1,
+                        "path": "compiled"},
+                       {"table": "catalog_sales", "chunks": 4, "syncs": 9,
+                        "path": "eager", "reason": "not chunk-invariant"}]},
+        "query2": {"hostSyncs": 1, "syncWaitMs": 1.0},
+    }
+    bench.write_perf(times, perf, platform="axon")
+    text = open(tmp_path / "PERF.md").read()
+    assert "platform: axon." in text
+    assert "attached chip" not in text
+    assert "Streamed >HBM scans: 2 (1 compiled chunk pipeline, "\
+           "1 eager fallback)." in text
 
 
 def test_collect_sf10_failure_capture_excludes_restart_suffix(tmp_path):

@@ -41,6 +41,32 @@ def _citations(doc):
         yield m.group(0).rstrip("/.")
 
 
+def test_perf_header_stamps_real_platform():
+    """PERF.md provenance: the header must carry the platform string the
+    serving child actually measured on (``jax.devices()[0].platform``,
+    stamped by bench.py's write_perf), never the old assumed
+    "attached chip" wording — BENCH_r05 proved the assumption can be
+    false for an entire 3000s campaign."""
+    path = os.path.join(REPO, "PERF.md")
+    if not os.path.exists(path):
+        pytest.skip("no PERF.md artifact")
+    with open(path) as f:
+        head = f.read(2000)
+    assert "attached chip" not in head, (
+        "PERF.md carries the hardcoded 'attached chip' provenance; "
+        "regenerate with bench.py so the real jax platform is stamped")
+    m = re.search(r"platform: ([a-zA-Z0-9_-]+)\.", head)
+    assert m, "PERF.md header missing its 'platform: <name>.' stamp"
+    # the stamp must also be what bench.py writes today — a drifted
+    # generator would quietly re-introduce assumed provenance
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert "platform: {platform}" in src, \
+        "bench.py write_perf no longer stamps the measured platform"
+    assert ".platform" in src, \
+        "bench.py no longer reads jax.devices()[0].platform"
+
+
 @pytest.mark.parametrize("doc", DOCS)
 def test_cited_artifacts_are_committed(doc):
     tracked = _tracked()
