@@ -62,7 +62,8 @@ def ensure_data():
     if not os.path.exists(marker):
         os.makedirs(CACHE, exist_ok=True)
         subprocess.run([NDSGEN, "-scale", SCALE, "-dir", CACHE], check=True)
-        open(marker, "w").close()
+        with open(marker, "w"):
+            pass
     # one-time transcode: children load parquet ~5x faster than raw CSV;
     # invalidated whenever the CSV cache is newer (regenerated data)
     pq_marker = os.path.join(PQ_CACHE, ".complete")
@@ -79,7 +80,8 @@ def ensure_data():
             if os.path.exists(path):
                 pq.write_table(read_raw_table(path, fields),
                                os.path.join(PQ_CACHE, f"{table}.parquet"))
-        open(pq_marker, "w").close()
+        with open(pq_marker, "w"):
+            pass
     return PQ_CACHE
 
 
@@ -126,7 +128,8 @@ def order_by_history(names, baseline_file):
     queries, and pushes historically-absent outliers (e.g. an OOM-prone
     query) where their failure can't shadow cheap coverage."""
     try:
-        hist = json.load(open(baseline_file)).get("times") or {}
+        with open(baseline_file) as f:
+            hist = json.load(f).get("times") or {}
     except (OSError, ValueError):
         hist = {}
     known = sorted((n for n in names if n in hist), key=lambda n: hist[n])
@@ -235,8 +238,11 @@ def run_server():
                         stats.get("bytes_in_use", 0))
                     result["peakHbmBytes"] = int(
                         stats.get("peak_bytes_in_use", 0))
-            except Exception:
-                pass
+            except Exception as exc:
+                # allocator stats are best-effort diagnostics, but their
+                # absence must leave a trace, not vanish
+                print(f"# memory_stats unavailable: {exc}",
+                      file=sys.stderr)
             print(json.dumps(result), flush=True)
         except Exception as e:                        # keep serving
             print(json.dumps({"name": name,
@@ -263,7 +269,8 @@ def resolve_baseline(baseline_file, times, n_total):
     base = None
     if os.path.exists(baseline_file):
         try:
-            base = json.load(open(baseline_file))
+            with open(baseline_file) as f:
+                base = json.load(f)
         except ValueError:
             base = None
     if base is None and not os.environ.get("NDS_BENCH_SEED_BASELINE"):
@@ -285,7 +292,8 @@ def resolve_baseline(baseline_file, times, n_total):
                "n_queries": len(merged), "times": merged}
         if isinstance(base, dict) and "note" in base:
             out["note"] = base["note"]
-        json.dump(out, open(baseline_file, "w"), indent=1, sort_keys=True)
+        with open(baseline_file, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
     return vs
 
 
@@ -468,7 +476,9 @@ def run_parent(t_entry):
     child = ChildServer()
     resume_path = os.environ.get("NDS_BENCH_RESULTS_JSONL")
     resume_platform = load_resume(resume_path, times, perf)
-    resume_f = open(resume_path, "a") if resume_path else None
+    resume_f = None
+    if resume_path:
+        resume_f = open(resume_path, "a")
 
     def on_signal(signum, frame):
         emit(times, len(names))

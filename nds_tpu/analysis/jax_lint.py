@@ -38,7 +38,10 @@ with ``# nds-lint: ignore[rule]`` on the flagged line or the line above):
   chunk is the O(chunks) control-plane cost the compiled streaming
   executor (``engine/stream.py``) exists to remove — new chunk loops
   must stay device-resident or route through it. The surviving eager
-  fallback loop is baselined.
+  fallback loop is baselined. The rule also sees ONE level down: a call
+  from the loop body to a module-local helper (bare name or
+  ``self.method``) whose body syncs directly is flagged at the call
+  site — the gap that let a sync hide behind a one-line refactor.
 """
 
 from __future__ import annotations
@@ -57,6 +60,72 @@ _TIME_FUNCS = {"time", "perf_counter", "perf_counter_ns", "monotonic"}
 _CHUNK_ITER_FUNCS = {"device_chunks", "padded_chunks"}
 # engine entry points that resolve a device scalar on host
 _ENGINE_SYNC_FUNCS = {"host_sync", "count_int", "resolve_counts"}
+
+
+def _sync_primitive(node) -> str | None:
+    """The host-sync primitive a Call node invokes, or None. One shared
+    matcher for the direct chunk-loop check and the helper pre-pass."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        owner = f.value.id if isinstance(f.value, ast.Name) else None
+        if f.attr == "item" and not node.args:
+            return ".item()"
+        if owner in ("np", "numpy") and f.attr in _SYNC_NP_FUNCS:
+            return f"np.{f.attr}()"
+        if f.attr == "device_get":
+            return "device_get()"
+        if f.attr == "to_int" and not node.args:
+            return ".to_int()"
+        if f.attr in _ENGINE_SYNC_FUNCS:
+            return f"{f.attr}()"
+    elif isinstance(f, ast.Name) and f.id in _ENGINE_SYNC_FUNCS:
+        return f"{f.id}()"
+    return None
+
+
+def _collect_sync_helpers(tree) -> dict:
+    """Map each module-local function/method to (lineno, primitive) of
+    the first host-sync primitive its body calls directly — the
+    one-level-down index the chunk-loop rule resolves call sites
+    against. Methods are keyed ``(ClassName, name)`` and module-level or
+    nested functions ``(None, name)``, so a ``self.helper()`` call only
+    resolves against its own class — a same-named method on an unrelated
+    class in the module is not evidence. Nested function definitions
+    attribute to the innermost def (matching how a call would reach
+    them)."""
+    helpers: dict = {}
+
+    class _Scan(ast.NodeVisitor):
+        def __init__(self):
+            self.stack: list = []       # (class-or-None, name) per def
+            self.classes: list = []
+
+        def visit_ClassDef(self, node):
+            self.classes.append(node.name)
+            self.generic_visit(node)
+            self.classes.pop()
+
+        def visit_FunctionDef(self, node):
+            # a def at class-body level is that class's method; any other
+            # def (module-level, or nested in a function) is reachable as
+            # a bare name
+            cls = self.classes[-1] if self.classes and not self.stack \
+                else None
+            self.stack.append((cls, node.name))
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            what = _sync_primitive(node)
+            if what and self.stack:
+                helpers.setdefault(self.stack[-1],
+                                   (node.lineno, what))
+            self.generic_visit(node)
+
+    _Scan().visit(tree)
+    return helpers
 
 
 def _is_jit_decorator(dec) -> tuple[bool, set]:
@@ -87,11 +156,14 @@ def _is_jit_decorator(dec) -> tuple[bool, set]:
 
 
 class _Lint(ast.NodeVisitor):
-    def __init__(self, path: str, rel: str, source: str):
+    def __init__(self, path: str, rel: str, source: str,
+                 sync_helpers: dict | None = None):
         self.rel = rel
+        self.sync_helpers = sync_helpers or {}
         self.lines = source.splitlines()
         self.findings: list = []
         self.scope_stack = ["<module>"]
+        self.class_stack: list = []  # enclosing class names (self.X calls)
         self.loop_depth = 0
         self.chunk_loop_depth = 0    # for-loops over device/padded chunks
         self.jit_params: list = []   # stack of traced-param name sets
@@ -158,6 +230,11 @@ class _Lint(ast.NodeVisitor):
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
+    def visit_ClassDef(self, node):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
     def _in_jit(self) -> bool:
         return self.jit_depth > 0
 
@@ -220,28 +297,34 @@ class _Lint(ast.NodeVisitor):
         compiled streaming executor removes (engine/stream.py)."""
         if not self.chunk_loop_depth:
             return
-        f = node.func
-        what = None
-        if isinstance(f, ast.Attribute):
-            owner = f.value.id if isinstance(f.value, ast.Name) else None
-            if f.attr == "item" and not node.args:
-                what = ".item()"
-            elif owner in ("np", "numpy") and f.attr in _SYNC_NP_FUNCS:
-                what = f"np.{f.attr}()"
-            elif f.attr == "device_get":
-                what = "device_get()"
-            elif f.attr == "to_int" and not node.args:
-                what = ".to_int()"
-            elif f.attr in _ENGINE_SYNC_FUNCS:
-                what = f"{f.attr}()"
-        elif isinstance(f, ast.Name) and f.id in _ENGINE_SYNC_FUNCS:
-            what = f"{f.id}()"
+        what = _sync_primitive(node)
         if what:
             self._emit("chunk-loop-host-sync", "warning",
                        f"{what} inside a device_chunks() loop syncs once "
                        "per chunk (O(chunks) round trips); keep the chunk "
                        "pipeline device-resident or route it through the "
                        "compiled streaming executor", node.lineno)
+            return
+        # one level down: a call to a module-local helper whose body syncs
+        # directly — the refactor that used to hide a per-chunk sync.
+        # ``self.helper()`` resolves only against the enclosing class's
+        # methods; a bare name only against module-level/nested functions.
+        f = node.func
+        key = None
+        if isinstance(f, ast.Name):
+            key = (None, f.id)
+        elif isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and self.class_stack:
+            key = (self.class_stack[-1], f.attr)
+        hit = key is not None and self.sync_helpers.get(key)
+        if hit and key[1] not in _CHUNK_ITER_FUNCS:
+            lineno, prim = hit
+            self._emit("chunk-loop-host-sync", "warning",
+                       f"{key[1]}() (defined in this module, syncs via "
+                       f"{prim} at line {lineno}) called inside a "
+                       "device_chunks() loop: one host sync per chunk "
+                       "hidden one level down", node.lineno)
 
     def visit_Call(self, node):
         self._check_chunk_loop_sync(node)
@@ -462,7 +545,7 @@ def lint_file(path: str, rel: str | None = None) -> list:
     except SyntaxError as e:
         return [Finding(rel, "<module>", "syntax-error", "error",
                         str(e), e.lineno or 0)]
-    lint = _Lint(path, rel, source)
+    lint = _Lint(path, rel, source, _collect_sync_helpers(tree))
     lint.visit(tree)
     lint.finish()
     return lint.findings

@@ -435,6 +435,70 @@ def test_jax_lint_chunk_loop_scoping(tmp_path):
     assert not [f for f in fs if f.rule == "chunk-loop-host-sync"]
 
 
+def test_jax_lint_chunk_loop_helper_sync(tmp_path):
+    # the one-level-down gap: a host sync hidden in a module-local helper
+    # (bare name or self.method) called from a chunk-loop body is flagged
+    # at the call site, with the helper's sync primitive named
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import ops as E
+        def _resolve(chunk):
+            return E.count_int(chunk.nrows)
+        class P:
+            def _peek(self, chunk):
+                return chunk.total.item()
+            def run(self, table):
+                outs = []
+                for chunk in table.device_chunks():
+                    n = _resolve(chunk)
+                    m = self._peek(chunk)
+                    outs.append(chunk)
+                return outs
+    """, rel="nds_tpu/report.py")
+    assert [f.rule for f in fs] == ["chunk-loop-host-sync"] * 2
+    assert "_resolve" in fs[0].message and "count_int()" in fs[0].message
+    assert "_peek" in fs[1].message and ".item()" in fs[1].message
+
+
+def test_jax_lint_chunk_loop_helper_scoping(tmp_path):
+    # sync-free helpers, helpers called outside chunk loops, and
+    # non-local callees (module attributes) are all clean
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import ops as E
+        def _shape(chunk):
+            return chunk.plen
+        def run(table, other):
+            n = E.count_int(other.nrows)     # outside any chunk loop
+            outs = []
+            for chunk in table.device_chunks():
+                outs.append(_shape(chunk))   # helper does not sync
+                outs.append(E.bucket_len(4)) # non-sync engine call
+            return outs, n
+    """, rel="nds_tpu/report.py")
+    assert not [f for f in fs if f.rule == "chunk-loop-host-sync"], \
+        "\n".join(str(f) for f in fs)
+
+
+def test_jax_lint_chunk_loop_helper_class_scoped(tmp_path):
+    # a self.method call resolves only against the ENCLOSING class: a
+    # same-named method on an unrelated class in the module that does
+    # sync is not evidence against this class's sync-free one
+    fs = lint_snippet(tmp_path, """
+        class A:
+            def _peek(self):
+                return self.total.item()
+        class B:
+            def _peek(self, chunk):
+                return chunk.plen
+            def run(self, table):
+                outs = []
+                for chunk in table.device_chunks():
+                    outs.append(self._peek(chunk))
+                return outs
+    """, rel="nds_tpu/report.py")
+    assert not [f for f in fs if f.rule == "chunk-loop-host-sync"], \
+        "\n".join(str(f) for f in fs)
+
+
 def test_jax_lint_suppression_honored(tmp_path):
     fs = lint_snippet(tmp_path, """
         def drain(cols):
@@ -573,6 +637,153 @@ def test_driver_audit_attribute_held_handle_ok(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# exec audit: static execution-path classification + sync bounds
+# ---------------------------------------------------------------------------
+
+
+def exec_audit(sql, streamed=("store_sales",)):
+    from nds_tpu.analysis.exec_audit import ExecAuditor
+    return ExecAuditor(streamed=set(streamed)).audit_sql(sql)
+
+
+def test_exec_audit_ab_templates_classification():
+    """The 4 A/B templates pinned by test_synccount: the static auditor
+    must predict the exact path the runtime takes — 3 compiled-stream
+    (the chunk pipeline) and the IN-subquery template eager-fallback with
+    the subquery-residual reason (its residual needs the catalog, which
+    the chunk-invariant program must not close over) — with every
+    compiled scan's steady-state bound inside the streamed budget."""
+    from nds_tpu.analysis.exec_audit import (CLASS_COMPILED, CLASS_EAGER,
+                                             SYNC_BUDGET)
+    from test_synccount import _STREAM_AB_QUERIES
+    reports = [exec_audit(q) for q, _must in _STREAM_AB_QUERIES]
+    got = [r.classification for r in reports]
+    want = [CLASS_COMPILED if must else CLASS_EAGER
+            for _q, must in _STREAM_AB_QUERIES]
+    assert got == want, got
+    for r in reports:
+        if r.classification == CLASS_COMPILED:
+            assert r.sync_bound is not None and r.sync_bound <= SYNC_BUDGET
+            for s in r.scans:
+                assert s.compiled and s.gate_bound <= SYNC_BUDGET
+    eager = reports[[m for _q, m in _STREAM_AB_QUERIES].index(False)]
+    assert "subquery-residual" in eager.reasons
+
+
+def test_exec_audit_device_resident():
+    from nds_tpu.analysis.exec_audit import CLASS_DEVICE
+    r = exec_audit("""
+        select d_year, i_brand_id, sum(ss_ext_sales_price) s
+        from store_sales, date_dim, item
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        group by d_year, i_brand_id""", streamed=())
+    assert r.classification == CLASS_DEVICE
+    assert not r.scans
+    assert r.sync_bound is not None
+
+
+def test_exec_audit_reason_codes():
+    """Each eager-fallback reason code fires on its canonical shape,
+    mirroring the runtime routing of engine/stream.py."""
+    # cartesian layout in the streamed graph: _cartesian's host count
+    # resolve raises StreamSyncError under stream bounds
+    r = exec_audit("select count(*) c from store_sales, item "
+                   "where ss_ext_sales_price > 9990 and i_brand_id = 1")
+    assert r.classification == "eager-fallback"
+    assert r.reasons == ("chunk-dependent-host-read",)
+    assert r.sync_bound is None and r.per_chunk >= 1
+    # bare scan: the survivor accumulator keeps every chunk row
+    r = exec_audit("select ss_item_sk from store_sales")
+    assert r.reasons == ("accumulator-overflow",)
+    # bare scan on an outer-join side: extras semantics materialize the
+    # whole side
+    r = exec_audit("select d_year, ss_item_sk from date_dim left join "
+                   "store_sales on d_date_sk = ss_sold_date_sk")
+    assert r.reasons == ("outer-join-extras",)
+    # ...but a filtered side of an outer join streams compiled
+    r = exec_audit("select ss_item_sk, i_brand_id from store_sales "
+                   "left join item on ss_item_sk = i_item_sk "
+                   "where ss_ext_sales_price > 9900")
+    assert r.classification == "compiled-stream"
+
+
+def test_exec_audit_cte_shadowing_not_streamed():
+    # a CTE shadowing a chunked catalog name resolves to the CTE (the
+    # planner checks the cte stack first): nothing streams
+    from nds_tpu.analysis.exec_audit import CLASS_DEVICE
+    r = exec_audit("""
+        with store_sales as (select d_date_sk x from date_dim)
+        select count(*) c from store_sales""")
+    assert r.classification == CLASS_DEVICE
+
+
+def test_exec_audit_gate_trips_on_sync_heavy_plan():
+    """Negative case: a deliberately sync-heavy — but still streamable —
+    toy plan must trip the stream-sync-budget gate: two chained non-PK
+    outer joins (2 syncs each: probe + batched extras) on top of the
+    pipeline's materializing sync, a multi-key grouping (batched resolve
+    + packed range probe) and the output resolution exceed the budget."""
+    from nds_tpu.analysis.exec_audit import (SYNC_BUDGET,
+                                             reports_to_findings)
+    r = exec_audit("""
+        select ss_item_sk, d_year, count(*) c
+        from store_sales
+             left join date_dim on ss_sold_date_sk = d_moy
+             left join item on ss_item_sk = i_brand_id
+        where ss_quantity > 0
+        group by ss_item_sk, d_year""")
+    assert r.classification == "compiled-stream"
+    assert r.scans[0].gate_bound > SYNC_BUDGET
+    fs = reports_to_findings([r])
+    assert [f.rule for f in fs] == ["stream-sync-budget"]
+    assert fs[0].severity == "error"
+
+
+def test_exec_audit_corpus_full_coverage():
+    """Every template statement receives a classification with reasons,
+    deterministically, and no streamable plan's static bound exceeds the
+    streamed budget — the lint-gate contract over the shipped corpus."""
+    from nds_tpu.analysis.exec_audit import (CLASS_COMPILED, CLASS_EAGER,
+                                             CLASS_DEVICE, SYNC_BUDGET,
+                                             audit_exec_corpus,
+                                             reports_to_findings)
+    reports = audit_exec_corpus()
+    assert len(reports) >= 99
+    allowed = {CLASS_COMPILED, CLASS_EAGER, CLASS_DEVICE}
+    for r in reports:
+        assert r.classification in allowed, (r.query, r.classification)
+        if r.classification == CLASS_EAGER:
+            assert r.reasons, f"{r.query}: eager with no reason code"
+        for s in r.scans:
+            if s.compiled:
+                assert s.gate_bound <= SYNC_BUDGET, (r.query, s)
+    assert not reports_to_findings(reports)
+    again = audit_exec_corpus()
+    assert [r.to_dict() for r in again] == [r.to_dict() for r in reports]
+
+
+def test_exec_audit_differential_harness():
+    """The lockstep contract: static path/sync predictions must match the
+    runtime StreamEvent evidence on the A/B templates, and the harness
+    must FAIL on the injected model-drift fixture (flipped paths) — a
+    gate that cannot fail proves nothing."""
+    import importlib.util
+    path = os.path.join(REPO, "tools", "exec_audit_diff.py")
+    spec = importlib.util.spec_from_file_location("exec_audit_diff", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    queries, _ = mod._load_ab_templates()
+    reports = mod.predict(queries)
+    evidence = mod.collect_runtime_evidence()
+    ok, lines = mod.compare(reports, evidence)
+    assert ok, "\n".join(lines)
+    drift_ok, drift_lines = mod.compare(reports, evidence,
+                                        inject_drift=True)
+    assert not drift_ok, "drift fixture failed to fail"
+    assert any("MISMATCH" in ln for ln in drift_lines)
+
+
+# ---------------------------------------------------------------------------
 # baseline diffing + CI gate
 # ---------------------------------------------------------------------------
 
@@ -624,6 +835,62 @@ def test_lint_cli_gate(tmp_path):
     assert r.returncode == 2
     assert "unresolved-column" in r.stdout
     assert "cartesian-join" in r.stdout
+
+
+def test_lint_cli_format_json(tmp_path):
+    """--format json: stable machine-readable findings on stdout (rule,
+    file, symbol, count, baselined) with the exit-code contract
+    unchanged."""
+    r = _run_lint("--format", "json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["version"] == 1
+    assert set(doc["pass_counts"]) == {"plan-audit", "exec-audit",
+                                       "jax-lint", "driver-audit"}
+    entries = doc["findings"]
+    assert entries == sorted(
+        entries, key=lambda e: (e["rule"], e["file"], e["symbol"]))
+    for e in entries:
+        assert set(e) == {"rule", "file", "symbol", "severity", "count",
+                          "baselined"}
+    # the shipped tree is fully baselined: the q77 cartesian and nothing new
+    assert doc["new"] == 0
+    assert [(e["rule"], e["baselined"]) for e in entries] == \
+        [("cartesian-join", True)]
+    # a failing corpus keeps stdout pure JSON and still exits 2
+    seeded = tmp_path / "templates"
+    shutil.copytree(TEMPLATES, seeded)
+    (seeded / "querybad.tpl").write_text("select ss_no_such from store_sales\n")
+    with open(seeded / "templates.lst", "a") as f:
+        f.write("querybad.tpl\n")
+    r = _run_lint("--templates", str(seeded), "--format", "json")
+    assert r.returncode == 2
+    doc = json.loads(r.stdout)
+    assert doc["new"] >= 1
+    assert any(e["rule"] == "unresolved-column" and not e["baselined"]
+               for e in doc["findings"])
+
+
+def test_lint_cli_stream_report():
+    r = _run_lint("--stream-report")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "per-template execution-path classification" in r.stdout
+    for klass in ("compiled-stream", "eager-fallback", "device-resident"):
+        assert klass in r.stdout
+    # the report is the widening worklist: eager scans carry reason codes
+    assert "subquery-residual" in r.stdout
+
+
+def test_lint_cli_changed_fast_path():
+    """--changed lints only the current git diff; in this checkout it must
+    still honor the baseline gate, and it is incompatible with
+    --update-baseline (which needs the full findings set)."""
+    r = _run_lint("--changed")
+    assert r.returncode in (0, 2), r.stdout + r.stderr
+    assert "changed files)" in r.stdout or "# lint" in r.stdout
+    r = _run_lint("--changed", "--update-baseline")
+    assert r.returncode != 0
+    assert "--changed" in r.stderr
 
 
 def test_lint_cli_update_baseline_refuses_foreign_corpus(tmp_path):

@@ -50,8 +50,8 @@ def main():
             with open(q0) as f:
                 manifest["power_stream_queries"] = sum(
                     1 for ln in f if ln.startswith("-- start query"))
-    json.dump(manifest, open(os.path.join(out, "manifest.json"), "w"),
-              indent=1)
+    with open(os.path.join(out, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
     print(f"collected {len(copied)} files -> {out}")
 
 

@@ -60,7 +60,8 @@ def main():
                  "start) per phase (ref: nds/nds_bench.py:138-157)."),
         "phases": phases,
     }
-    json.dump(doc, open(out_path, "w"), indent=1)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
     print(f"wrote {out_path}: " +
           ", ".join(f"Ttt{i+1}={p.get('Ttt_s', '?')}s"
                     for i, p in enumerate(phases)))

@@ -39,7 +39,8 @@ def ensure_data():
     if not os.path.exists(marker):
         os.makedirs(CACHE, exist_ok=True)
         subprocess.run([NDSGEN, "-scale", SCALE, "-dir", CACHE], check=True)
-        open(marker, "w").close()
+        with open(marker, "w"):
+            pass
     return CACHE
 
 

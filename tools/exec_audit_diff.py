@@ -1,0 +1,216 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Differential validation of the static execution auditor.
+
+The exec auditor (``nds_tpu/analysis/exec_audit.py``) is a *model* of the
+streaming executor's routing and of the engine's sync effects; a model
+nobody checks drifts. This harness replays the ``tests/test_synccount.py``
+A/B templates — the same four statements whose runtime behavior tier-1
+pins — through the real engine on a chunked toy session, drains the
+``StreamEvent`` listener evidence, and fails when the static prediction
+disagrees with what actually ran:
+
+* **path** — a template the auditor classifies ``compiled-stream`` must
+  produce a ``compiled`` StreamEvent (and ``eager-fallback`` an ``eager``
+  one), on the cold sight and the warm (pipeline-cached) sight;
+* **sync count** — for compiled templates, the runtime's warm host-sync
+  total must fit the static ``sync_bound``, the cold total must fit
+  ``sync_bound + first_sight``, and every compiled scan's ``gate_bound``
+  must respect the streamed-path budget (:data:`exec_audit.SYNC_BUDGET`).
+
+``--inject-drift`` flips every predicted path before comparing — a model-
+drift fixture that MUST fail, proving the harness can catch a stale model
+(``tests/test_analysis.py`` asserts both directions). Run it after any
+change to ``Planner._stream_join_parts``, ``engine/stream.py`` routing, or
+the sync behavior of ``engine/ops.py``: the static model and the executor
+are kept in lockstep the same way ``plan_audit`` tracks
+``Planner._resolve_name``.
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_ab_templates():
+    """The canonical A/B statements + the chunked toy session builder, from
+    tests/test_synccount.py — importing the pinned definitions keeps the
+    harness and the tier-1 budget tests on the same fixtures by
+    construction."""
+    path = os.path.join(REPO, "tests", "test_synccount.py")
+    spec = importlib.util.spec_from_file_location("_synccount_fixtures",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod._STREAM_AB_QUERIES, mod._chunked_star_session
+
+
+def collect_runtime_evidence():
+    """Execute each A/B template twice (cold: record+compile; warm:
+    pipeline-cache hit) and return per-template evidence dicts."""
+    import numpy as np
+
+    from nds_tpu.engine import ops as E
+    from nds_tpu.listener import drain_stream_events
+
+    queries, make_session = _load_ab_templates()
+    session = make_session(np.random.default_rng(42))
+    drain_stream_events()
+    evidence = []
+    for sql, _must_stream in queries:
+        runs = []
+        for sight in ("cold", "warm"):
+            before = E.sync_count()
+            rows = session.sql(sql).collect()
+            used = E.sync_count() - before
+            events = drain_stream_events()
+            runs.append({
+                "sight": sight, "syncs": used,
+                "paths": [e.path for e in events],
+                "reasons": [e.reason for e in events if e.reason],
+                "rows": len(rows),
+            })
+        evidence.append({"sql": sql, "cold": runs[0], "warm": runs[1]})
+    return evidence
+
+
+def predict(queries):
+    from nds_tpu.analysis.exec_audit import ExecAuditor
+    auditor = ExecAuditor(streamed={"store_sales"})
+    return [auditor.audit_sql(sql, query=f"ab{i + 1}")
+            for i, (sql, _must) in enumerate(queries)]
+
+
+# Which runtime fallback-reason texts each static reason code explains.
+# The runtime reports the *mechanism* (which exception broke the trace);
+# the model reports the *plan feature* that guarantees that mechanism —
+# this table is the bridge, checked below so a new routing cause in the
+# executor (a reason text no static code explains) fails the harness.
+_REASON_EVIDENCE = {
+    "subquery-residual": ("trace diverged: unknown table",),
+    "chunk-dependent-host-read": ("not chunk-invariant", "trace diverged"),
+    "non-invariant-graph": ("not chunk-invariant", "trace diverged"),
+    "outer-join-extras": ("bound-bucket overflow",),
+    "accumulator-overflow": ("bound-bucket overflow",),
+}
+
+
+def compare(reports, evidence, inject_drift=False):
+    """Check static predictions against runtime evidence; returns
+    (ok, lines). ``inject_drift`` flips each predicted path first — the
+    self-test fixture that must produce mismatches."""
+    from nds_tpu.analysis.exec_audit import (CLASS_COMPILED, CLASS_EAGER,
+                                             SYNC_BUDGET)
+    ok = True
+    lines = []
+    for rep, ev in zip(reports, evidence):
+        klass = rep.classification
+        if inject_drift:
+            klass = CLASS_EAGER if klass == CLASS_COMPILED \
+                else CLASS_COMPILED
+        if klass == CLASS_COMPILED:
+            want = "compiled"
+        elif klass == CLASS_EAGER:
+            want = "eager"
+        else:
+            # device-resident / unknown: no streamed scan runs, so the
+            # listener must record NO StreamEvents at all
+            want = "<none>"
+        head = f"[{rep.query}] static={klass} bound={rep.sync_bound}"
+        problems = []
+        for sight in ("cold", "warm"):
+            paths = set(ev[sight]["paths"]) or {"<none>"}
+            if paths != {want}:
+                problems.append(f"{sight} path {sorted(paths)} != "
+                                f"predicted {want!r}")
+        if klass == CLASS_COMPILED:
+            if rep.sync_bound is None:
+                problems.append("compiled classification with an unbounded "
+                                "sync model")
+            else:
+                if ev["warm"]["syncs"] > rep.sync_bound:
+                    problems.append(
+                        f"warm used {ev['warm']['syncs']} syncs > static "
+                        f"bound {rep.sync_bound}")
+                if ev["cold"]["syncs"] > rep.sync_bound + rep.first_sight:
+                    problems.append(
+                        f"cold used {ev['cold']['syncs']} syncs > bound "
+                        f"{rep.sync_bound} + first-sight {rep.first_sight}")
+            for s in rep.scans:
+                if s.compiled and s.gate_bound > SYNC_BUDGET:
+                    problems.append(f"scan {s.table} gate bound "
+                                    f"{s.gate_bound} > budget {SYNC_BUDGET}")
+        elif klass == CLASS_EAGER:
+            # the runtime's fallback reason must be one the model names:
+            # an eager event whose reason text no static reason code
+            # explains means the executor grew a routing cause the model
+            # does not know about
+            if not rep.reasons and not inject_drift:
+                problems.append("eager classification with no reason code")
+            explained = tuple(pat for code in rep.reasons
+                              for pat in _REASON_EVIDENCE.get(code, ()))
+            for sight in ("cold", "warm"):
+                for rt_reason in ev[sight]["reasons"]:
+                    if rt_reason == "NDS_TPU_STREAM_EXEC=eager":
+                        continue        # env escape hatch, not plan-driven
+                    if inject_drift:
+                        continue        # paths already mismatch loudly
+                    if not any(pat in rt_reason for pat in explained):
+                        problems.append(
+                            f"{sight} runtime reason {rt_reason!r} is not "
+                            f"explained by static codes {rep.reasons}")
+        if not ev["warm"]["rows"]:
+            problems.append("A/B template unexpectedly returned no rows")
+        if problems:
+            ok = False
+            lines.append(f"MISMATCH {head}")
+            lines.extend(f"    {p}" for p in problems)
+        else:
+            lines.append(
+                f"ok {head} :: cold {ev['cold']['syncs']} syncs / warm "
+                f"{ev['warm']['syncs']} syncs via {ev['warm']['paths']}")
+    return ok, lines
+
+
+def run_diff(inject_drift=False):
+    """Full harness: predict, execute, compare. Returns (ok, lines)."""
+    queries, _ = _load_ab_templates()
+    reports = predict(queries)
+    evidence = collect_runtime_evidence()
+    return compare(reports, evidence, inject_drift=inject_drift)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="differential validation: static exec-audit "
+        "predictions vs runtime StreamEvent evidence")
+    ap.add_argument("--inject-drift", action="store_true",
+                    help="flip every predicted path before comparing: the "
+                    "harness must FAIL (model-drift self-test)")
+    args = ap.parse_args(argv)
+    ok, lines = run_diff(inject_drift=args.inject_drift)
+    for ln in lines:
+        print(ln)
+    if args.inject_drift:
+        if ok:
+            print("# DRIFT FIXTURE FAILED TO FAIL: the harness cannot "
+                  "detect model drift")
+            return 1
+        print("# drift fixture correctly rejected (harness is live)")
+        return 0
+    if ok:
+        print("# exec-audit differential: static model matches runtime "
+              "evidence")
+        return 0
+    print("# exec-audit differential FAILED: update the static model in "
+          "nds_tpu/analysis/exec_audit.py in lockstep with the executor")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

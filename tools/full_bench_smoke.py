@@ -93,7 +93,8 @@ metrics_report_path: {root}/metrics.csv
 
     metrics = os.path.join(root, "metrics.csv")
     assert os.path.exists(metrics), "metrics.csv missing"
-    body = open(metrics).read()
+    with open(metrics) as f:
+        body = f.read()
     print("---- metrics.csv ----")
     print(body)
     perf = None

@@ -1,7 +1,7 @@
 # Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
-"""Static analysis gate: plan auditor + engine lint + driver lint.
+"""Static analysis gate: plan auditor + exec auditor + engine/driver lint.
 
-Runs the three :mod:`nds_tpu.analysis` passes entirely on host (no device,
+Runs the four :mod:`nds_tpu.analysis` passes entirely on host (no device,
 no data) and exits nonzero when any finding is NOT covered by the
 checked-in baseline (``nds_tpu/analysis/baseline.json``) — the accepted
 pre-existing findings. New code must come in clean; accepting a new
@@ -10,7 +10,13 @@ review as a baseline diff.
 
 Usage:
     python tools/lint.py                      # gate against the baseline
-    python tools/lint.py --json report.json   # machine-readable findings
+    python tools/lint.py --json report.json   # full findings report file
+    python tools/lint.py --format json        # stable findings JSON on
+                                              # stdout (CI annotation)
+    python tools/lint.py --stream-report      # per-template execution-path
+                                              # classification (exec-audit)
+    python tools/lint.py --changed            # lint only files in the
+                                              # current git diff
     python tools/lint.py --templates DIR      # audit a different corpus
     python tools/lint.py --update-baseline    # accept current findings
     python tools/lint.py --no-baseline        # print everything, exit 0/2
@@ -23,6 +29,7 @@ the flagged line or the line above.
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -34,26 +41,112 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from nds_tpu.analysis import (BASELINE_PATH, diff_against_baseline,  # noqa: E402
                               load_baseline, write_baseline)
-from nds_tpu.analysis.driver_audit import audit_drivers  # noqa: E402
-from nds_tpu.analysis.jax_lint import lint_tree  # noqa: E402
+from nds_tpu.analysis.driver_audit import audit_drivers, driver_files  # noqa: E402
+from nds_tpu.analysis.exec_audit import (audit_exec_corpus,  # noqa: E402
+                                         format_stream_report,
+                                         reports_to_findings)
+from nds_tpu.analysis.jax_lint import lint_file, lint_tree  # noqa: E402
 from nds_tpu.analysis.plan_audit import audit_corpus  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_passes(template_dir=None):
+def git_changed_files():
+    """Repo-relative paths changed vs HEAD (staged + unstaged + untracked),
+    or None when the repo state cannot be read (not a git checkout) — the
+    caller falls back to the full run."""
+    try:
+        out = subprocess.run(["git", "-C", REPO, "status", "--porcelain"],
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    paths = set()
+    for ln in out.stdout.splitlines():
+        if len(ln) <= 3:
+            continue
+        p = ln[3:].strip().strip('"')
+        if " -> " in p:                  # rename: lint the new path
+            p = p.split(" -> ")[-1]
+        paths.add(p)
+    return sorted(paths)
+
+
+# a change under any of these invalidates the corpus-level audits (the
+# analyzers mirror planner/engine semantics — the lockstep rule)
+_CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
+                 "nds_tpu/engine", "nds_tpu/schema.py")
+
+
+def run_passes(template_dir=None, changed=None, want_reports=False):
+    """Run the analysis passes; ``changed`` (repo-relative paths) restricts
+    the fast path to affected files only. Returns (findings, pass counts,
+    exec reports, elapsed seconds)."""
     t0 = time.time()
     findings = []
     counts = {}
-    for name, fn in (("plan-audit",
-                      lambda: audit_corpus(template_dir)),
-                     ("jax-lint", lambda: lint_tree(
-                         os.path.join(REPO, "nds_tpu"))),
-                     ("driver-audit", lambda: audit_drivers(REPO))):
+    reports = []
+    corpus_affected = (
+        changed is None or template_dir is not None or want_reports
+        or any(c.startswith(_CORPUS_ROOTS) for c in changed))
+
+    def run_exec():
+        reports.extend(audit_exec_corpus(template_dir))
+        return reports_to_findings(reports)
+
+    def run_jax():
+        if changed is None:
+            return lint_tree(os.path.join(REPO, "nds_tpu"))
+        out = []
+        for rel in changed:
+            if rel.startswith("nds_tpu/") and rel.endswith(".py") and \
+                    os.path.exists(os.path.join(REPO, rel)):
+                out.extend(lint_file(os.path.join(REPO, rel), rel))
+        return out
+
+    def run_drivers():
+        from nds_tpu.analysis.driver_audit import audit_file
+        if changed is None:
+            return audit_drivers(REPO)
+        allowed = {os.path.relpath(p, REPO) for p in driver_files(REPO)}
+        out = []
+        for rel in changed:
+            if rel in allowed:
+                out.extend(audit_file(os.path.join(REPO, rel), rel))
+        return out
+
+    passes = []
+    if corpus_affected:
+        passes.append(("plan-audit", lambda: audit_corpus(template_dir)))
+        passes.append(("exec-audit", run_exec))
+    passes.append(("jax-lint", run_jax))
+    passes.append(("driver-audit", run_drivers))
+    for name, fn in passes:
         got = fn()
         counts[name] = len(got)
         findings.extend(got)
-    return findings, counts, time.time() - t0
+    return findings, counts, reports, time.time() - t0
+
+
+def _aggregate(findings, new):
+    """Stable machine-readable aggregation for ``--format json``: one entry
+    per (rule, file, symbol) with occurrence count and whether every
+    occurrence is baseline-covered."""
+    new_keys = {}
+    for f in new:
+        k = (f.rule, f.file, f.query)
+        new_keys[k] = new_keys.get(k, 0) + 1
+    agg = {}
+    for f in findings:
+        k = (f.rule, f.file, f.query)
+        e = agg.setdefault(k, {"rule": f.rule, "file": f.file,
+                               "symbol": f.query, "severity": f.severity,
+                               "count": 0, "baselined": True})
+        e["count"] += 1
+    for k, n in new_keys.items():
+        agg[k]["baselined"] = False
+    return [agg[k] for k in sorted(agg)]
 
 
 def main(argv=None) -> int:
@@ -64,6 +157,16 @@ def main(argv=None) -> int:
                     "shipped corpus)")
     ap.add_argument("--json", default=None,
                     help="write the full findings report to this path")
+    ap.add_argument("--format", default="text", choices=("text", "json"),
+                    help="stdout format: human text (default) or stable "
+                    "machine-readable findings JSON for CI annotation "
+                    "(exit-code contract unchanged)")
+    ap.add_argument("--stream-report", action="store_true",
+                    help="print the exec-audit per-template execution-path "
+                    "classification (the streamability worklist)")
+    ap.add_argument("--changed", action="store_true",
+                    help="fast path: lint only files in the current git "
+                    "diff (full run when not in a git checkout)")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: the checked-in one)")
     ap.add_argument("--update-baseline", action="store_true",
@@ -75,9 +178,15 @@ def main(argv=None) -> int:
         ap.error("--update-baseline over a --templates corpus would "
                  "overwrite the checked-in baseline with findings from a "
                  "foreign corpus; pass an explicit --baseline path")
+    if args.update_baseline and args.changed:
+        ap.error("--update-baseline needs the full findings set; "
+                 "drop --changed")
     baseline_path = args.baseline or BASELINE_PATH
 
-    findings, counts, elapsed = run_passes(args.templates)
+    changed = git_changed_files() if args.changed else None
+
+    findings, counts, reports, elapsed = run_passes(
+        args.templates, changed=changed, want_reports=args.stream_report)
 
     # diff against the PRE-update baseline so a --json report written
     # alongside --update-baseline shows what was just accepted
@@ -92,6 +201,8 @@ def main(argv=None) -> int:
             "new": [f.to_dict() for f in new],
             "all": [f.to_dict() for f in findings],
         }
+        if reports:
+            doc["stream_report"] = [r.to_dict() for r in reports]
         with open(args.json, "w") as f:
             json.dump(doc, f, indent=1)
 
@@ -101,16 +212,31 @@ def main(argv=None) -> int:
               f"({len(findings)} accepted findings)")
         return 0
 
+    out = sys.stderr if args.format == "json" else sys.stdout
+
+    # under --format json stdout must stay a single parseable JSON
+    # document: the human table moves to stderr and the classification
+    # rides in the document's "stream_report" field instead
+    if args.stream_report and reports:
+        print(format_stream_report(reports), file=out)
     for f in new:
-        print(f"NEW {f}")
+        print(f"NEW {f}", file=out)
     n_err = sum(1 for f in new if f.severity == "error")
     summary = ", ".join(f"{name}: {n}" for name, n in counts.items())
-    print(f"# lint: {summary}; {len(findings) - len(new)} baselined, "
-          f"{len(new)} new ({n_err} errors) in {elapsed:.1f}s")
+    scope = f" ({len(changed)} changed files)" if changed is not None else ""
+    print(f"# lint{scope}: {summary}; {len(findings) - len(new)} baselined, "
+          f"{len(new)} new ({n_err} errors) in {elapsed:.1f}s", file=out)
+    if args.format == "json":
+        doc = {"version": 1, "elapsed_s": round(elapsed, 2),
+               "pass_counts": counts, "new": len(new),
+               "findings": _aggregate(findings, new)}
+        if args.stream_report and reports:
+            doc["stream_report"] = [r.to_dict() for r in reports]
+        print(json.dumps(doc, indent=1))
     if new:
         print("# gate FAILED: fix the findings above, suppress with "
               "'# nds-lint: ignore[rule]', or accept deliberately with "
-              "tools/lint.py --update-baseline")
+              "tools/lint.py --update-baseline", file=out)
         return 2
     return 0
 

@@ -295,7 +295,8 @@ def main():
     params_file = os.path.join(REPO, "tools", "oracle_params.json")
     overrides = {}
     if os.path.exists(params_file):
-        overrides = json.load(open(params_file)).get("overrides", {})
+        with open(params_file) as f:
+            overrides = json.load(f).get("overrides", {})
 
     default_seed = 19620718
     _ctx: dict = {}          # scale -> (sqlite con, engine session)

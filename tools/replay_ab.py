@@ -131,7 +131,8 @@ def main():
                  "be ON where geomean_replay_s < geomean_eager_s."),
         "results": results,
     }
-    json.dump(agg, open(args.out, "w"), indent=1)
+    with open(args.out, "w") as f:
+        json.dump(agg, f, indent=1)
     print(f"# wrote {args.out}: {len(ok)}/{len(results)} replayed, "
           f"eager {agg['geomean_eager_s']}s vs replay "
           f"{agg['geomean_replay_s']}s", file=sys.stderr)
