@@ -191,11 +191,15 @@ def run_server():
             # roofline decomposition measured on the final pass (sync
             # counts are deterministic per query; wait time is weather)
             from nds_tpu.listener import drain_stream_events
+            from nds_tpu.obs import export as obs_export
+            from nds_tpu.obs import trace as obs_trace
             drain_stream_events()        # count only the final pass's scans
+            obs_trace.drain_spans()
             s0, w0 = _ops.sync_count(), _ops.sync_wait_ns()
             sess.sql(sql).collect()
             t2 = time.perf_counter()
             stream_events = drain_stream_events()
+            trace_records = obs_trace.drain_spans()
             ms = min(t1 - t0, t2 - t1) * 1000.0
             syncs = _ops.sync_count() - s0
             sync_ms = (_ops.sync_wait_ns() - w0) / 1e6
@@ -226,6 +230,18 @@ def run_server():
                      "syncs": e.syncs, "path": e.path,
                      **({"reason": e.reason} if e.reason else {})}
                     for e in stream_events]
+            if trace_records:
+                # per-phase attribution of the final timed pass (obs
+                # layer; zero added syncs): plan vs drive vs materialize
+                # per query, plus top sync-charging host-read sites
+                roll = obs_export.rollup(trace_records)
+                result["tracePhases"] = roll
+                trace_d = os.environ.get("NDS_BENCH_TRACE_DIR")
+                if trace_d:
+                    os.makedirs(trace_d, exist_ok=True)
+                    obs_export.write_chrome_trace(
+                        os.path.join(trace_d, f"{name}.trace.json"),
+                        trace_records, query=name, roll=roll)
             try:
                 # per-query HBM footprint where the backend exposes
                 # allocator stats (local chips; the tunneled attachment
@@ -459,7 +475,7 @@ def load_resume(path, times, perf):
                 perf[msg["name"]] = {k: msg[k] for k in
                                      ("hostSyncs", "syncWaitMs", "scanBytes",
                                       "scanGBps", "warmS", "compileS",
-                                      "streamedScans")
+                                      "streamedScans", "tracePhases")
                                      if k in msg}
             elif "platform" in msg:
                 platform = msg["platform"]

@@ -69,6 +69,11 @@ if __name__ == "__main__":
     parser.add_argument("--profile",
                         help="folder for per-query device profiler traces "
                         "(XProf/TensorBoard dumps).")
+    parser.add_argument("--trace-dir",
+                        help="folder for per-query Chrome trace_event JSON "
+                        "files from the engine's span tracer (load in "
+                        "chrome://tracing or Perfetto; aggregate with "
+                        "tools/trace_report.py). Zero added host syncs.")
     parser.add_argument("--warm",
                         action="store_true",
                         help="precompile pass: execute the stream once to "
@@ -99,4 +104,5 @@ if __name__ == "__main__":
                      args.json_summary_folder,
                      args.allow_failure,
                      profile_folder=args.profile,
-                     warm=args.warm)
+                     warm=args.warm,
+                     trace_dir=args.trace_dir)

@@ -59,6 +59,16 @@ with no device in the loop, answers for every template:
    ``first_sight`` and are NOT gated: they amortize across a Power Run's
    2-4 executions the same way XLA compiles do.
 
+**Trace instrumentation is sync-free.** The obs span layer
+(:mod:`nds_tpu.obs`) wraps the instrumented phases in host-clock spans
+that read only the thread's existing sync/wait/compile counters, so the
+sync-effect model charges instrumentation NOTHING — no bound in this
+module changes when tracing is on (the default). That zero is itself a
+checked contract: the differential harness cross-checks every drained
+``stream`` span's sync delta against its ``StreamEvent.syncs`` on the A/B
+templates, so the trace layer cannot silently start paying for its own
+metrics without failing tier-1.
+
 The model is a **checked contract**, not documentation: the differential
 harness (``tools/exec_audit_diff.py``) replays the ``test_synccount`` A/B
 templates through the real engine and fails when the static path or bound

@@ -30,6 +30,18 @@ with ``# nds-lint: ignore[rule]`` on the flagged line or the line above):
 * ``time-in-jit`` — ``time.time()``/``time.perf_counter()`` inside a
   ``jax.jit``-decorated function: it runs once at trace time and becomes
   a constant in the compiled program.
+* ``span-in-jit`` — an ``obs.span(...)`` trace context entered inside a
+  ``jax.jit``-decorated function. Spans read the host clock and the
+  thread's sync counters at enter/exit; under tracing those run ONCE at
+  trace time (measuring compile, not execution) and the span would be
+  recorded on every retrace instead of every run. The runtime half of
+  this guard is ``obs.trace.span()`` returning a null span under
+  ``replay_mode() == "replay"``; this rule catches the static case the
+  runtime guard cannot see — a span lexically inside a jitted body.
+  Only obs-owned calls trip it: conventional module names
+  (``obs``/``_obs``/``obs_trace``), any ``nds_tpu.obs`` import alias,
+  and bare names from-imported from the obs package — an unrelated
+  ``.span()`` (``re.Match.span()``) or a local helper does not.
 * ``chunk-loop-host-sync`` — a host-sync primitive (``.item()``,
   ``np.asarray``/``np.array``, ``device_get``, ``.to_int()``, or the
   engine's ``host_sync``/``count_int``/``resolve_counts``) lexically
@@ -180,6 +192,32 @@ class _Lint(ast.NodeVisitor):
         self.fn_param_use: dict = {}     # func name -> (params, records)
         self.param_use_stack: list = []  # (param names, {param: record})
         self.cache_arg_calls: list = []  # (callee, pos|kwarg, cache name)
+        # span-in-jit: names that refer to the obs trace module (by
+        # convention or import alias) and to its span() function (by
+        # from-import). An unrelated .span() — re.Match.span(), a local
+        # helper — must NOT trip the rule.
+        self.obs_aliases: set = {"obs", "_obs", "obs_trace"}
+        self.span_funcs: set = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            if a.asname and a.name.startswith("nds_tpu.obs"):
+                self.obs_aliases.add(a.asname)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        mod = node.module or ""
+        if mod.startswith("nds_tpu.obs") or mod == "nds_tpu":
+            for a in node.names:
+                bound = a.asname or a.name
+                if a.name == "span":
+                    self.span_funcs.add(bound)
+                elif a.name in ("trace", "export", "obs"):
+                    # only actual submodule names become module aliases —
+                    # a from-imported function/class (SpanRecord, rollup)
+                    # is not an owner whose .span() is a trace context
+                    self.obs_aliases.add(bound)
+        self.generic_visit(node)
 
     def _emit(self, rule: str, severity: str, message: str,
               lineno: int) -> None:
@@ -352,6 +390,20 @@ class _Lint(ast.NodeVisitor):
                 self._emit("time-in-jit", "error",
                            f"time.{f.attr}() inside a jax.jit function is "
                            "evaluated once at trace time", node.lineno)
+            if f.attr == "span" and owner in self.obs_aliases and \
+                    self._in_jit():
+                self._emit("span-in-jit", "error",
+                           "obs.span(...) inside a jax.jit function reads "
+                           "the host clock at trace time (tracer hazard); "
+                           "open the span around the jitted call instead",
+                           node.lineno)
+        elif isinstance(f, ast.Name) and f.id in self.span_funcs and \
+                self._in_jit():
+            self._emit("span-in-jit", "error",
+                       "span(...) inside a jax.jit function reads the "
+                       "host clock at trace time (tracer hazard); open "
+                       "the span around the jitted call instead",
+                       node.lineno)
         self._note_cache_method_write(node)
         # a *_CACHE passed as an argument aliases it to the callee's
         # parameter — resolved against the callee's use at finish()

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
 import threading
 import time
 
@@ -33,6 +34,7 @@ import numpy as np
 
 from nds_tpu.engine.column import Column, is_dec
 from nds_tpu.engine.table import DeviceTable
+from nds_tpu.obs import trace as _trace
 
 # ---------------------------------------------------------------------------
 # bucketed shapes
@@ -277,10 +279,32 @@ def _resolve_refs(val):
     return val
 
 
+def _sync_site() -> str:
+    """First non-ops engine frame above the fetch — the call-site tag
+    every sync-charging host read carries into the trace layer (the
+    first-class form of tools/sync_profile.py's old monkeypatch). Frame
+    walk only, no source reads; runs only when a sync was charged."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if "nds_tpu" in fn and not fn.endswith("ops.py"):
+            return (f"{os.path.basename(fn)}:{f.f_lineno}:"
+                    f"{f.f_code.co_name}")
+        f = f.f_back
+    return "?"
+
+
 def host_read(tag: str, fetch):
     """The single host-read chokepoint. Off: just fetch. Record: fetch and
     log. Replay: pop the recorded value — no device contact (large arrays
-    come back as traced jit operands via :class:`ArgRef`)."""
+    come back as traced jit operands via :class:`ArgRef`).
+
+    With tracing on (nds_tpu/obs), a fetch that charged host syncs emits
+    a sync-site event naming its engine call site. Attribution is
+    re-entrancy-exact: a fetch that re-enters host_read (nested reads —
+    e.g. a count fallback inside a span fetch) charges each site only its
+    OWN syncs, which the old monkeypatch double-counted. Pure counter
+    arithmetic — zero additional syncs."""
     mode = replay_mode()
     if mode == "replay":
         log = _sync_tls.replay_log
@@ -290,7 +314,19 @@ def host_read(tag: str, fetch):
             raise ReplayMismatch(f"expected {got!r}, hit {tag!r} at {i}")
         _sync_tls.replay_cursor = i + 1
         return _resolve_refs(log[i][1])
+    if not _trace.on():
+        val = fetch()
+        if mode == "record":
+            _sync_tls.replay_log.append((tag, val))
+        return val
+    s0, w0 = sync_count(), sync_wait_ns()
+    a_s0, a_w0 = _trace.attributed()
     val = fetch()
+    a_s1, a_w1 = _trace.attributed()
+    own = (sync_count() - s0) - (a_s1 - a_s0)
+    if own > 0:
+        own_wait = max((sync_wait_ns() - w0) - (a_w1 - a_w0), 0)
+        _trace.note_sync(tag, own, own_wait, _sync_site())
     if mode == "record":
         _sync_tls.replay_log.append((tag, val))
     return val

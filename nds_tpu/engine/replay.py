@@ -41,6 +41,7 @@ import jax
 
 from nds_tpu.engine import ops as E
 from nds_tpu.engine.table import DeviceTable
+from nds_tpu.obs import trace as _obs
 
 
 class _NotReplayable(Exception):
@@ -284,12 +285,16 @@ class CompiledQuery:
         # bounded segment programs instead (compile ~linear, K dispatches)
         E.resolve_counts()   # the trace must start with a clean batch
         self.jitted = jax.jit(traced)
-        try:
-            closed = self.jitted.trace(
-                self._flat_args(), self.operands).jaxpr
-        except AttributeError:  # pragma: no cover - older jax
-            closed = jax.make_jaxpr(traced)(
-                self._flat_args(), self.operands)
+        # span covers the whole-query re-trace (the host-side cost of
+        # turning the recording into one program); XLA backend compile
+        # lands on the first run() and is metered there via compile_ns
+        with _obs.span("replay.compile", statement="whole-query"):
+            try:
+                closed = self.jitted.trace(
+                    self._flat_args(), self.operands).jaxpr
+            except AttributeError:  # pragma: no cover - older jax
+                closed = jax.make_jaxpr(traced)(
+                    self._flat_args(), self.operands)
         n_eqns = _count_eqns(closed.jaxpr)
         if n_eqns > _MAX_EQNS:
             self.jitted = None
@@ -335,6 +340,11 @@ class CompiledQuery:
             else env[v] for v in self.seg_outsrc)
 
     def run(self, block: bool = False) -> DeviceTable:
+        with _obs.span("replay.drive",
+                       segments=len(self.segments or ()) or 1):
+            return self._run(block)
+
+    def _run(self, block: bool) -> DeviceTable:
         from nds_tpu.engine.column import Column
         names, kinds, dicts, valided, plen, bound = self.out_template
         # the first call traces: stray real counts must not sit in the

@@ -16,6 +16,7 @@ import pyarrow as pa
 
 from nds_tpu.engine.column import from_arrow
 from nds_tpu.engine.table import DeviceTable
+from nds_tpu.obs import trace as _obs
 from nds_tpu.sql import ast as A
 from nds_tpu.sql.parser import parse
 from nds_tpu.sql.planner import ExecError, Planner
@@ -37,7 +38,10 @@ class Result:
         return self.table.column_names
 
     def to_arrow(self) -> pa.Table:
-        return self.table.to_arrow()
+        # the device->host result fetch: the "materialize" phase of the
+        # query trace (collect() and the write path both land here)
+        with _obs.span("materialize"):
+            return self.table.to_arrow()
 
     def collect(self):
         """Device -> host gather; returns list of row tuples (the reference's
@@ -369,8 +373,9 @@ class Session:
             else:
                 E.resolve_counts()   # stray pending counts must not enter
                 t0 = _time.perf_counter()
-                with E.recording() as log:
-                    table = planner.query(stmt)
+                with _obs.span("replay.record"):
+                    with E.recording() as log:
+                        table = planner.query(stmt)
                 # block to completion so eager_s is a true wall, comparable
                 # to the blocked replay wall (async dispatch would
                 # otherwise under-count the eager side and mis-tune the
@@ -405,6 +410,9 @@ class Session:
         return out
 
     def sql(self, text: str) -> Result:
+        # scope this thread's trace ring (mirrors the thread-scoped
+        # listener): a query-executing thread drains only its own spans
+        _obs.attach()
         stmt = parse(text)
         planner = Planner(self.catalog, base_tables=self.base_tables)
         # roofline accounting: bytes of every catalog table the statement

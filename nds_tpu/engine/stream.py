@@ -56,6 +56,7 @@ from nds_tpu.engine import ops as E
 from nds_tpu.engine.column import Column, slice_col_prefix
 from nds_tpu.engine.table import DeviceTable
 from nds_tpu.listener import record_stream_event
+from nds_tpu.obs import trace as _obs
 
 log = logging.getLogger(__name__)
 
@@ -148,6 +149,9 @@ class StreamPipeline:
         # tables' device memory for the cache entry's lifetime
         self.part_refs = part_refs
         self.jitted = None
+        # first jitted dispatch traces+compiles the per-chunk program;
+        # the trace layer labels that dispatch "stream.compile"
+        self.traced_once = False
 
     # ------------------------------------------------------------- compile
 
@@ -249,11 +253,20 @@ class StreamPipeline:
             # asynchronous dispatch: the compiled call returns immediately,
             # so the NEXT chunk's arrow->device conversion (host slice +
             # upload) below overlaps this chunk's device compute — the
-            # double-buffered prefetch
-            acc = self.jitted(self._flatten_chunk(cur), n_dev, parts_flat,
-                              self.operands, acc)
+            # double-buffered prefetch. The first dispatch of a fresh
+            # pipeline traces+compiles the per-chunk program; the span
+            # names that cost so the compile-vs-drive split is visible
+            # per chunk in the query trace.
+            phase = "stream.drive" if self.traced_once else "stream.compile"
+            with _obs.span(phase, chunk=n_chunks):
+                acc = self.jitted(self._flatten_chunk(cur), n_dev,
+                                  parts_flat, self.operands, acc)
+            self.traced_once = True
             n_chunks += 1
-            cur = next(chunks, None)
+            # prefetch span: host-side arrow slice + upload of the next
+            # chunk, overlapping the dispatched compute above
+            with _obs.span("stream.prefetch", chunk=n_chunks):
+                cur = next(chunks, None)
         datas, valids, n_dev, ovf = acc
 
         def fetch():
@@ -261,7 +274,8 @@ class StreamPipeline:
             return int(total), bool(overflowed)
 
         # THE one materializing sync of the pipeline
-        total, overflowed = E.timed_read("stream_final", fetch)
+        with _obs.span("stream.materialize", chunks=n_chunks):
+            total, overflowed = E.timed_read("stream_final", fetch)
         if overflowed:
             return None, n_chunks
         names, kinds, dicts, valided, dtypes = self.out_template
@@ -377,6 +391,8 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
     except Exception:
         pipe = None                      # unkeyable statement: no cache
     parts_flat = tuple(tuple(flat) for (_spec, flat) in part_infos)
+    # label the planner's enclosing "stream" span with the cache outcome
+    _obs.annotate(pipelineCache="hit" if pipe is not None else "miss")
 
     if pipe is None:
         pipe = _build_pipeline(planner, parts, keep, alias, join_preds,
@@ -417,6 +433,7 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
                  "re-running %s eagerly", alias)
         return None, "bound-bucket overflow"
     record_stream_event(alias, ran, E.sync_count() - syncs0, "compiled")
+    _obs.annotate(path="compiled", chunks=ran)
     return out, None
 
 
@@ -443,11 +460,12 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
         sub[i] = _rebuild_part(part_infos[pi][0], part_infos[pi][1])
         pi += 1
     try:
-        with E.recording() as rec_log:
-            with E.stream_bounds():
-                out0 = planner._join_parts(sub, list(join_preds),
-                                           list(where_conjuncts),
-                                           list(masked_sources))
+        with _obs.span("stream.record", table=alias):
+            with E.recording() as rec_log:
+                with E.stream_bounds():
+                    out0 = planner._join_parts(sub, list(join_preds),
+                                               list(where_conjuncts),
+                                               list(masked_sources))
     except E.StreamSyncError as exc:
         log.info("streamed scan %s not chunk-invariant: %s", alias, exc)
         return None

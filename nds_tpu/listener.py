@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import threading
 import traceback
+from collections import deque
 from dataclasses import dataclass, field
 
 
@@ -64,8 +65,10 @@ class Manager:
     _listeners: list[FailureListener] = []       # (owner_thread_id, listener) pairs
     _owners: list[int] = []
     _lock = threading.Lock()
-    unattributed: list[TaskFailure] = []
+    # bounded ring (newest kept): a failure storm on an unattributed
+    # thread must evict O(1) per record, not O(n) list.pop(0)
     _UNATTRIBUTED_MAX = 1000
+    unattributed: deque = deque(maxlen=_UNATTRIBUTED_MAX)
 
     @classmethod
     def register(cls, listener: FailureListener) -> None:
@@ -89,8 +92,6 @@ class Manager:
             targets = [l for l, o in zip(cls._listeners, cls._owners)
                        if o == me]
             if not targets:
-                if len(cls.unattributed) >= cls._UNATTRIBUTED_MAX:
-                    cls.unattributed.pop(0)
                 cls.unattributed.append(TaskFailure(where, reason, fatal))
                 return
         for l in targets:
@@ -123,17 +124,20 @@ def record_stream_event(where: str, chunks: int, syncs: int, path: str,
     concurrent Throughput streams account their own pipelines."""
     lst = getattr(_stream_tls, "events", None)
     if lst is None:
-        lst = _stream_tls.events = []
-    if len(lst) >= 1000:            # diagnostics, never unbounded
-        lst.pop(0)
+        # deque(maxlen): diagnostics ring, never unbounded, O(1) evict
+        lst = _stream_tls.events = deque(maxlen=1000)
     lst.append(StreamEvent(where, chunks, syncs, path, reason))
 
 
 def drain_stream_events() -> list:
-    """Return and clear the calling thread's streamed-scan events."""
-    lst = getattr(_stream_tls, "events", None) or []
-    _stream_tls.events = []
-    return lst
+    """Return and clear the calling thread's streamed-scan events
+    (oldest-first drain order; the ring keeps the newest 1000)."""
+    lst = getattr(_stream_tls, "events", None)
+    if not lst:
+        return []
+    out = list(lst)
+    lst.clear()
+    return out
 
 
 def report_task_failure(where: str, exc: BaseException | str,

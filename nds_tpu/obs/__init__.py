@@ -1,0 +1,24 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Query-trace observability layer.
+
+The reference harness answers "where did the time go?" with Spark's event
+log + listener bus; the TPU engine's only slice of that was the failure
+listener (:mod:`nds_tpu.listener`) and raw sync counters. This package is
+the rest: process-local, thread-scoped span tracing and per-phase metrics
+over the planner, the streaming executor and the replay compiler, with a
+hard contract — **tracing adds zero host syncs** (host-clock spans only;
+device numbers are harvested exclusively at syncs the engine already
+pays; ``tests/test_obs.py`` proves sync-count parity traced vs untraced).
+
+* :mod:`nds_tpu.obs.trace` — nestable spans with sync/wait/compile
+  counters bridged from :mod:`nds_tpu.engine.ops`, ring-buffer bounded
+  and thread-scoped with an explicit drain (the
+  ``drain_stream_events`` discipline).
+* :mod:`nds_tpu.obs.export` — Chrome ``trace_event`` export
+  (``chrome://tracing`` / Perfetto) and the per-query rollup dict the
+  drivers merge into their JSON summaries.
+"""
+
+from nds_tpu.obs.trace import (NULL_SPAN, SpanRecord, SyncSite,  # noqa: F401
+                               annotate, attach, drain_spans, on,
+                               set_enabled, span, unattributed)

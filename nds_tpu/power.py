@@ -133,7 +133,8 @@ def run_query_stream(input_prefix: str,
                      allow_failure: bool = False,
                      warehouse_type: str | None = None,
                      profile_folder: str | None = None,
-                     warm: bool = False) -> None:
+                     warm: bool = False,
+                     trace_dir: str | None = None) -> None:
     """The Power Run loop (ref: nds/nds_power.py:184-322).
 
     ``warm=True`` is the precompile pass (round-4 verdict missing #3):
@@ -141,7 +142,13 @@ def run_query_stream(input_prefix: str,
     cache, so a following official run's TPower is execution, not
     shape-universe compilation — the analog of the warmed JVM+plugin the
     reference assumes. The same loop runs (cache keys come from real
-    compiles), but the time-log marker rows say Warm, never Power."""
+    compiles), but the time-log marker rows say Warm, never Power.
+
+    ``trace_dir`` writes one Chrome ``trace_event`` JSON per query
+    (``{query}.trace.json``, loadable in chrome://tracing / Perfetto)
+    from the obs span layer; the per-phase rollup lands in every query's
+    JSON summary either way (tracing is default-on and adds zero host
+    syncs)."""
     from nds_tpu.engine.session import Session
 
     queries_reports = []
@@ -183,6 +190,11 @@ def run_query_stream(input_prefix: str,
     from nds_tpu.parallel.admission import from_env as admission_from_env
     admission = admission_from_env()
 
+    from nds_tpu.obs import export as _obs_export
+    from nds_tpu.obs import trace as _obs_trace
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+
     power_start = int(time.time())
     for query_name, q_content in query_dict.items():
         print(f"====== Run {query_name} ======")
@@ -200,6 +212,7 @@ def run_query_stream(input_prefix: str,
         from nds_tpu.listener import drain_stream_events as _drain_stream
         _ops.enable_compile_meter()
         _drain_stream()          # setup leftovers must not charge query 1
+        _obs_trace.drain_spans()  # same for trace records
         syncs_before = _ops.sync_count()
         wait_before = _ops.sync_wait_ns()
         fetch_before = _ops.fetch_bytes()
@@ -214,9 +227,10 @@ def run_query_stream(input_prefix: str,
                     else contextlib.nullcontext(0.0))
         try:
             with slot_ctx as queued_s:
-                elapsed = q_report.report_on(run_one_query, session,
-                                             q_content, query_name,
-                                             output_path, output_format)
+                with _obs_trace.span("query", query=query_name):
+                    elapsed = q_report.report_on(run_one_query, session,
+                                                 q_content, query_name,
+                                                 output_path, output_format)
         finally:
             if trace_ctx is not None:
                 trace_ctx.__exit__(None, None, None)
@@ -242,6 +256,18 @@ def run_query_stream(input_prefix: str,
                  "path": e.path,
                  **({"reason": e.reason} if e.reason else {})}
                 for e in stream_events]
+        # per-phase trace rollup (nds_tpu/obs): where the query's wall
+        # went — plan, stream record/compile/drive, materialize — plus
+        # the top sync-charging host-read sites; the full span tree goes
+        # to --trace-dir as a Chrome trace_event file
+        trace_records = _obs_trace.drain_spans()
+        if trace_records:
+            roll = _obs_export.rollup(trace_records)
+            q_report.summary["trace"] = roll
+            if trace_dir:
+                _obs_export.write_chrome_trace(
+                    os.path.join(trace_dir, f"{query_name}.trace.json"),
+                    trace_records, query=query_name, roll=roll)
         # compile-vs-execute split (round-4 verdict missing #3): compileMs
         # is XLA backend compilation charged to this query's wall (zero on
         # a warm shape universe / persistent-cache hit); the remainder is

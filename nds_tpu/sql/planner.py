@@ -27,6 +27,7 @@ from nds_tpu.engine import ops as E
 from nds_tpu.engine.column import Column
 from nds_tpu.engine.table import DeviceTable
 from nds_tpu.engine.window import WindowContext
+from nds_tpu.obs import trace as _obs
 from nds_tpu.sql import ast as A
 from nds_tpu.sql.parser import expr_key
 
@@ -227,15 +228,20 @@ class Planner:
             self._needed_names = self._collect_needed_names(q)
         scope = {}
         self.cte_stack.append(scope)
+        # the statement-level plan/execute span (this engine plans as it
+        # executes): one per top-level statement, CTE recursion rides
+        # inside it. A no-op under replay re-tracing (obs guard).
+        plan_span = _obs.span("plan") if top_level else _obs.NULL_SPAN
         try:
-            for name, cq in q.ctes:
-                scope[name.lower()] = self.query(cq)
-            out = self.set_expr(q.body)
-            if q.order_by:
-                out = self._apply_order_by(out, q.order_by, q.body)
-            if q.limit is not None:
-                out = E.limit_table(out, q.limit)
-            return out
+            with plan_span:
+                for name, cq in q.ctes:
+                    scope[name.lower()] = self.query(cq)
+                out = self.set_expr(q.body)
+                if q.order_by:
+                    out = self._apply_order_by(out, q.order_by, q.body)
+                if q.limit is not None:
+                    out = E.limit_table(out, q.limit)
+                return out
         finally:
             self.cte_stack.pop()
             # a reused Planner must not prune the next statement's scans
@@ -1031,37 +1037,45 @@ class Planner:
         for i in streamed:
             if i != keep:
                 parts[i] = parts[i].bind_whole(self)
-        syncs0 = E.sync_count()
-        reason = None
-        if os.environ.get("NDS_TPU_STREAM_EXEC",
-                          "compiled").lower() != "eager":
-            from nds_tpu.engine.stream import stream_execute
-            got, reason = stream_execute(self, parts, keep, join_preds,
-                                         where_conjuncts, list(sources))
-            if got is not None:
-                return got
-        else:
-            reason = "NDS_TPU_STREAM_EXEC=eager"
-        outs = []
-        n_chunks = 0
-        for chunk in parts[keep].device_chunks(self):
-            n_chunks += 1
-            sub = list(parts)
-            sub[keep] = chunk
-            out = self._join_parts(sub, join_preds, where_conjuncts,
-                                   list(sources))
-            if E.count_bound(out.nrows) or not outs:
-                outs.append(out)
-        result = E.concat_tables(outs) if len(outs) > 1 else outs[0]
-        if reason is not None:
-            # recorded AFTER the loop: the event's syncs charge the whole
-            # eager path (failed compile attempt + per-chunk loop), which
-            # is exactly the cost streamedScans exists to expose. reason
-            # None = replay-nested fallback, accounted by the outer pass.
-            from nds_tpu.listener import record_stream_event
-            record_stream_event(parts[keep].alias, n_chunks,
-                                E.sync_count() - syncs0, "eager", reason)
-        return result
+        # the span opens exactly where the StreamEvent sync window opens,
+        # so its sync delta equals the event's — the invariant
+        # tools/exec_audit_diff.py cross-checks (trace layer must never
+        # pay for its own metrics)
+        with _obs.span("stream", table=parts[keep].alias):
+            syncs0 = E.sync_count()
+            reason = None
+            if os.environ.get("NDS_TPU_STREAM_EXEC",
+                              "compiled").lower() != "eager":
+                from nds_tpu.engine.stream import stream_execute
+                got, reason = stream_execute(self, parts, keep, join_preds,
+                                             where_conjuncts, list(sources))
+                if got is not None:
+                    return got
+            else:
+                reason = "NDS_TPU_STREAM_EXEC=eager"
+            outs = []
+            n_chunks = 0
+            with _obs.span("stream.eager",
+                           reason=reason or "replay-nested"):
+                for chunk in parts[keep].device_chunks(self):
+                    n_chunks += 1
+                    sub = list(parts)
+                    sub[keep] = chunk
+                    out = self._join_parts(sub, join_preds, where_conjuncts,
+                                           list(sources))
+                    if E.count_bound(out.nrows) or not outs:
+                        outs.append(out)
+                result = E.concat_tables(outs) if len(outs) > 1 else outs[0]
+            if reason is not None:
+                # recorded AFTER the loop: the event's syncs charge the whole
+                # eager path (failed compile attempt + per-chunk loop), which
+                # is exactly the cost streamedScans exists to expose. reason
+                # None = replay-nested fallback, accounted by the outer pass.
+                from nds_tpu.listener import record_stream_event
+                record_stream_event(parts[keep].alias, n_chunks,
+                                    E.sync_count() - syncs0, "eager", reason)
+                _obs.annotate(path="eager", chunks=n_chunks, reason=reason)
+            return result
 
     def _join_parts(self, parts, join_preds, where_conjuncts, sources=None):
         """Join-graph execution: push single-table predicates down, then join

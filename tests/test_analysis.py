@@ -315,6 +315,87 @@ def test_jax_lint_static_metadata_if_ok(tmp_path):
     assert not fs
 
 
+def test_jax_lint_span_in_jit(tmp_path):
+    # an obs.span(...) context inside a jitted function reads the host
+    # clock at trace time — flagged whether spelled obs.span, trace.span
+    # or a bare imported span; nested defs inside the jit body count too
+    fs = lint_snippet(tmp_path, """
+        import jax
+        from nds_tpu.obs import trace as obs
+        from nds_tpu.obs.trace import span
+        @jax.jit
+        def kern(x):
+            with obs.span("drive"):
+                y = x + 1
+            with span("bare"):
+                y = y * 2
+            return y
+    """)
+    assert [f.rule for f in fs] == ["span-in-jit"] * 2
+    assert all(f.severity == "error" for f in fs)
+    fs = lint_snippet(tmp_path, """
+        import jax
+        @jax.jit
+        def kern(x):
+            def helper():
+                with obs.span("nested"):
+                    return x
+            return helper()
+    """)
+    assert [f.rule for f in fs] == ["span-in-jit"]
+
+
+def test_jax_lint_span_outside_jit_ok(tmp_path):
+    # the supported shape: open the span AROUND the jitted call
+    fs = lint_snippet(tmp_path, """
+        import jax
+        from nds_tpu.obs import trace as obs
+        @jax.jit
+        def kern(x):
+            return x + 1
+        def drive(x):
+            with obs.span("drive", chunk=0):
+                return kern(x)
+    """)
+    assert not [f for f in fs if f.rule == "span-in-jit"], \
+        "\n".join(str(f) for f in fs)
+
+
+def test_jax_lint_span_unrelated_callables_ok(tmp_path):
+    # .span() on a non-obs owner (re.Match.span) and a bare local helper
+    # named span() are NOT trace contexts — must not trip the CI gate
+    fs = lint_snippet(tmp_path, """
+        import re
+        import jax
+        @jax.jit
+        def kern(x):
+            m = re.match("a+", "aaa")
+            a, b = m.span()
+            def span(v):
+                return v + a
+            return span(x) + b
+    """)
+    assert not [f for f in fs if f.rule == "span-in-jit"], \
+        "\n".join(str(f) for f in fs)
+
+
+def test_jax_lint_span_import_alias_flagged(tmp_path):
+    # a non-conventional import alias still resolves to the obs module
+    fs = lint_snippet(tmp_path, """
+        import jax
+        import nds_tpu.obs.trace as tr
+        from nds_tpu.obs.trace import span as mark
+        @jax.jit
+        def kern(x):
+            with tr.span("a"):
+                x = x + 1
+            with mark("b"):
+                x = x * 2
+            return x
+    """)
+    assert [f.rule for f in fs] == ["span-in-jit"] * 2
+
+
 def test_jax_lint_factory_form_jit_decorator(tmp_path):
     # @jax.jit(static_argnums=...) — the decorator-factory spelling — must
     # be recognized like @jax.jit and functools.partial(jax.jit, ...)

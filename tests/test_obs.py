@@ -1,0 +1,349 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Query-trace subsystem (nds_tpu/obs): the zero-added-sync contract,
+thread scoping, ring bounds, Chrome export, driver wiring and the trace
+report aggregator."""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine import ops as E
+from nds_tpu.engine.session import Session
+from nds_tpu.obs import export as obs_export
+from nds_tpu.obs import trace as obs_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _synccount_fixtures():
+    """The pinned A/B templates + chunked session builder from
+    tests/test_synccount.py (same import-by-path discipline as
+    tools/exec_audit_diff.py: one set of fixtures, everywhere)."""
+    path = os.path.join(REPO, "tests", "test_synccount.py")
+    spec = importlib.util.spec_from_file_location("_synccount_fx", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod._STREAM_AB_QUERIES, mod._chunked_star_session
+
+
+def _span_names(records):
+    return [r.name for r in records if isinstance(r, obs_trace.SpanRecord)]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance contract: tracing adds ZERO host syncs
+# ---------------------------------------------------------------------------
+
+
+def test_tracing_adds_zero_syncs():
+    """ops.sync_count() must be IDENTICAL for a traced vs untraced run of
+    the A/B templates (chunked star join + streamed-fact filter): spans
+    read host clocks and existing counters only, never the device. Both
+    arms rebuild their session from the same seed and run cold (the
+    pipeline/rank caches key on buffer identity, so fresh sessions miss
+    equally)."""
+    queries, make_session = _synccount_fixtures()
+    ab = [q for q, _must in queries[:2]]
+    assert obs_trace.on(), "tracing must be default-on"
+
+    def run_arm():
+        s = make_session(np.random.default_rng(42))
+        obs_trace.drain_spans()
+        out = []
+        for q in ab:
+            before = E.sync_count()
+            rows = s.sql(q).collect()
+            out.append(E.sync_count() - before)
+            assert rows
+        return out
+
+    traced = run_arm()
+    obs_trace.set_enabled(False)
+    try:
+        untraced = run_arm()
+    finally:
+        obs_trace.set_enabled(True)
+    assert traced == untraced, \
+        f"tracing changed sync counts: traced={traced} untraced={untraced}"
+    obs_trace.drain_spans()                     # leftovers from this test
+
+
+def test_span_and_annotate_noop_under_replay():
+    """Under a replay re-trace both span() AND annotate() must be no-ops:
+    the caller's own span is a null context there, so an annotate would
+    stamp its attrs onto whatever OUTER span is open (e.g. the compile
+    span) at jit-trace time."""
+    obs_trace.drain_spans()
+    with obs_trace.span("outer") as outer:
+        with E.replaying([]):
+            with obs_trace.span("inner"):
+                obs_trace.annotate(path="eager", reason="bogus")
+    assert _span_names(obs_trace.drain_spans()) == ["outer"]
+    assert "path" not in outer.attrs and "reason" not in outer.attrs
+
+
+def test_disabled_tracing_records_nothing():
+    obs_trace.drain_spans()
+    obs_trace.set_enabled(False)
+    try:
+        with obs_trace.span("nope"):
+            pass
+    finally:
+        obs_trace.set_enabled(True)
+    assert "nope" not in _span_names(obs_trace.drain_spans())
+
+
+# ---------------------------------------------------------------------------
+# thread scoping (mirrors Manager.unattributed semantics)
+# ---------------------------------------------------------------------------
+
+
+def test_spans_thread_scoped_two_streams():
+    """Two concurrent in-process query streams (the Throughput Run shape)
+    each drain ONLY their own spans; a span finished on a thread that
+    never attached a ring lands in the unattributed diagnostics deque,
+    never in another stream's drain."""
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def stream(name, n_queries):
+        s = Session()
+        s.create_temp_view(name, pa.table(
+            {"v": pa.array(list(range(50)), pa.int64())}), base=True)
+        barrier.wait()
+        for _ in range(n_queries):
+            s.sql(f"select count(*) c from {name} where v < 10").collect()
+        results[name] = obs_trace.drain_spans()
+
+    t1 = threading.Thread(target=stream, args=("ta", 2))
+    t2 = threading.Thread(target=stream, args=("tb", 3))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert _span_names(results["ta"]).count("plan") == 2
+    assert _span_names(results["tb"]).count("plan") == 3
+
+    # unattributed: a bare thread (no Session.sql, no attach) opening a
+    # span must land in the diagnostics ring — mirroring
+    # Manager.unattributed for failures on shared callback threads
+    obs_trace.unattributed.clear()
+
+    def orphan():
+        with obs_trace.span("orphan-span"):
+            pass
+
+    t3 = threading.Thread(target=orphan)
+    t3.start(); t3.join()
+    assert any(getattr(r, "name", "") == "orphan-span"
+               for r in obs_trace.unattributed)
+    # and it must NOT appear in the main thread's ring
+    assert "orphan-span" not in _span_names(obs_trace.drain_spans())
+
+
+# ---------------------------------------------------------------------------
+# ring-buffer bounds (listener satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_event_ring_keeps_newest_1000():
+    from nds_tpu.listener import drain_stream_events, record_stream_event
+    drain_stream_events()
+    for i in range(1100):
+        record_stream_event(str(i), 1, 0, "eager")
+    got = drain_stream_events()
+    assert len(got) == 1000
+    assert got[0].where == "100" and got[-1].where == "1099", \
+        "eviction must drop oldest-first and preserve drain order"
+    assert drain_stream_events() == []
+
+
+def test_manager_unattributed_keeps_newest_1000():
+    from nds_tpu.listener import Manager
+    Manager.unattributed.clear()
+
+    def storm():
+        # a thread with no scoped listener: everything goes unattributed
+        for i in range(1100):
+            Manager.notify_all(f"w{i}", "boom")
+
+    t = threading.Thread(target=storm)
+    t.start(); t.join()
+    assert len(Manager.unattributed) == 1000
+    assert Manager.unattributed[0].where == "w100"
+    assert Manager.unattributed[-1].where == "w1099"
+    Manager.unattributed.clear()
+
+
+def test_span_ring_bounded():
+    obs_trace.drain_spans()
+    for i in range(obs_trace._RING_MAX + 50):
+        with obs_trace.span("s", i=i):
+            pass
+    got = obs_trace.drain_spans()
+    assert len(got) == obs_trace._RING_MAX
+    assert got[-1].attrs["i"] == obs_trace._RING_MAX + 49  # newest kept
+
+
+# ---------------------------------------------------------------------------
+# chunked pipeline phases + Chrome export + report
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def chunked_trace(tmp_path):
+    """Run one compiled-stream query and one eager-fallback query on a
+    chunked session; write both Chrome traces into a tmp trace dir."""
+    queries, make_session = _synccount_fixtures()
+    s = make_session(np.random.default_rng(42))
+    from nds_tpu.listener import drain_stream_events
+    drain_stream_events()
+    obs_trace.drain_spans()
+    tdir = tmp_path / "traces"
+    tdir.mkdir()
+    out = {}
+    # queries[0] pins the compiled pipeline; queries[3] is the IN-subquery
+    # automatic eager fallback
+    for label, (sql, _must) in (("compiled", queries[0]),
+                                ("fallback", queries[3])):
+        rows = s.sql(sql).collect()
+        assert rows
+        records = obs_trace.drain_spans()
+        obs_export.write_chrome_trace(
+            str(tdir / f"{label}.trace.json"), records, query=label)
+        out[label] = records
+    return tdir, out
+
+
+def test_chrome_trace_nested_phases(chunked_trace):
+    tdir, records = chunked_trace
+    with open(tdir / "compiled.trace.json") as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    for phase in ("plan", "stream", "stream.record", "stream.compile",
+                  "stream.drive", "stream.materialize", "materialize"):
+        assert phase in by_name, f"missing {phase} span in {sorted(by_name)}"
+
+    def contains(outer, inner):
+        return (outer["ts"] <= inner["ts"] and
+                inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"])
+
+    plan = by_name["plan"][0]
+    for phase in ("stream.record", "stream.compile", "stream.drive",
+                  "stream.materialize"):
+        assert contains(plan, by_name[phase][0]), \
+            f"{phase} must nest inside the plan span"
+    # 10 chunks: 1 compile dispatch + 9 drive dispatches
+    assert len(by_name["stream.compile"]) == 1
+    assert len(by_name["stream.drive"]) == 9
+    # the stream span carries the path + the pipeline-cache outcome
+    sargs = by_name["stream"][0]["args"]
+    assert sargs["path"] == "compiled" and sargs["chunks"] == 10
+    assert sargs["pipelineCache"] == "miss"
+    # sync-site events carry the first-class host_read attribution
+    sync_ev = [e for e in events if e["cat"] == "sync"]
+    assert sync_ev and all(":" in e["args"]["site"] for e in sync_ev)
+    # rollup rides in the file for readers that skip re-aggregation
+    assert "plan" in doc["nds"]["rollup"]["phases"]
+
+
+def test_eager_fallback_span_carries_reason(chunked_trace):
+    tdir, records = chunked_trace
+    stream = [r for r in records["fallback"]
+              if isinstance(r, obs_trace.SpanRecord) and r.name == "stream"]
+    assert stream and stream[0].attrs.get("path") == "eager"
+    assert stream[0].attrs.get("reason"), "fallback span must name why"
+    names = _span_names(records["fallback"])
+    assert "stream.eager" in names
+    roll = obs_export.rollup(records["fallback"])
+    assert roll["fallbacks"][0]["reason"] == stream[0].attrs["reason"]
+
+
+def test_trace_report_aggregates_dir(chunked_trace, capsys):
+    tdir, _records = chunked_trace
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(REPO, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.main([str(tdir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "2 queries" in out
+    assert "stream.drive" in out and "stream.compile" in out
+    assert "compile/drive ratio" in out
+    assert "top host-sync sites" in out
+    assert "eager-fallback cost by reason" in out
+    assert "trace diverged" in out or "not chunk-invariant" in out
+
+
+def test_span_syncs_match_stream_event(chunked_trace):
+    """The per-scan stream span must charge exactly the syncs its
+    StreamEvent recorded — the zero-added-sync bridge exec_audit_diff
+    gates in tier-1, asserted here at the unit level too."""
+    queries, make_session = _synccount_fixtures()
+    from nds_tpu.listener import drain_stream_events
+    s = make_session(np.random.default_rng(7))
+    drain_stream_events()
+    obs_trace.drain_spans()
+    s.sql(queries[0][0]).collect()
+    events = drain_stream_events()
+    spans = [r for r in obs_trace.drain_spans()
+             if isinstance(r, obs_trace.SpanRecord) and r.name == "stream"]
+    assert len(events) == 1 and len(spans) == 1
+    assert spans[0].syncs == events[0].syncs
+    assert spans[0].attrs["path"] == events[0].path
+
+
+# ---------------------------------------------------------------------------
+# driver wiring: power.py --trace-dir
+# ---------------------------------------------------------------------------
+
+
+def test_power_run_writes_trace_files(tmp_path, monkeypatch):
+    """A CPU run of the Power driver with trace_dir must produce, per
+    query, a valid Chrome trace_event JSON with nested spans, and stamp
+    the per-phase rollup into the query's JSON summary next to the sync
+    counters."""
+    import pyarrow.parquet as pq
+    from collections import OrderedDict
+
+    from nds_tpu import power
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.types import to_arrow as to_pa
+    fields = get_schemas(use_decimal=True)["item"]
+    monkeypatch.setattr(power, "get_schemas",
+                        lambda use_decimal: {"item": fields})
+    data = tmp_path / "data"
+    (data / "item").mkdir(parents=True)
+    cols = {f.name: pa.array([None, None], to_pa(f.type)) for f in fields}
+    cols["i_item_sk"] = pa.array([1, 2], to_pa(fields[0].type))
+    pq.write_table(pa.table(cols), data / "item" / "part-0.parquet")
+    tdir = tmp_path / "traces"
+    jdir = tmp_path / "json"
+    power.run_query_stream(str(data), None,
+                           OrderedDict(q="select count(*) c from item"),
+                           str(tmp_path / "t.csv"),
+                           json_summary_folder=str(jdir),
+                           trace_dir=str(tdir))
+    trace_file = tdir / "q.trace.json"
+    assert trace_file.exists()
+    with open(trace_file) as f:
+        doc = json.load(f)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"query", "plan", "materialize"} <= names
+    q = [e for e in doc["traceEvents"] if e["name"] == "query"][0]
+    p = [e for e in doc["traceEvents"] if e["name"] == "plan"][0]
+    assert q["ts"] <= p["ts"] and \
+        p["ts"] + p["dur"] <= q["ts"] + q["dur"], "plan nests under query"
+    summaries = list(jdir.glob("*.json"))
+    assert summaries
+    with open(summaries[0]) as f:
+        summary = json.load(f)
+    assert "plan" in summary["trace"]["phases"]
+    assert "syncSites" in summary["trace"]

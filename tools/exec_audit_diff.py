@@ -15,7 +15,14 @@ disagrees with what actually ran:
 * **sync count** — for compiled templates, the runtime's warm host-sync
   total must fit the static ``sync_bound``, the cold total must fit
   ``sync_bound + first_sight``, and every compiled scan's ``gate_bound``
-  must respect the streamed-path budget (:data:`exec_audit.SYNC_BUDGET`).
+  must respect the streamed-path budget (:data:`exec_audit.SYNC_BUDGET`);
+* **trace-layer parity** — the obs span tracer (``nds_tpu/obs``) is
+  sync-free by contract, and its per-scan ``stream`` span bridges the
+  same ``ops.sync_count()`` window the ``StreamEvent`` charges. Each
+  drained span's sync delta must EQUAL its StreamEvent's ``syncs`` on
+  every sight — if the trace layer ever started paying for its own
+  metrics (or drifted off the event window), span > event and this
+  harness fails before the budget tests would.
 
 ``--inject-drift`` flips every predicted path before comparing — a model-
 drift fixture that MUST fail, proving the harness can catch a stale model
@@ -57,10 +64,13 @@ def collect_runtime_evidence():
 
     from nds_tpu.engine import ops as E
     from nds_tpu.listener import drain_stream_events
+    from nds_tpu.obs import trace as obs_trace
 
     queries, make_session = _load_ab_templates()
     session = make_session(np.random.default_rng(42))
     drain_stream_events()
+    traced = obs_trace.on()
+    obs_trace.drain_spans()
     evidence = []
     for sql, _must_stream in queries:
         runs = []
@@ -69,13 +79,22 @@ def collect_runtime_evidence():
             rows = session.sql(sql).collect()
             used = E.sync_count() - before
             events = drain_stream_events()
+            # per-scan spans from the trace layer, execution order: each
+            # must carry the same sync delta its StreamEvent recorded
+            spans = [r for r in obs_trace.drain_spans()
+                     if getattr(r, "name", "") == "stream"
+                     and r.attrs.get("path")]
             runs.append({
                 "sight": sight, "syncs": used,
                 "paths": [e.path for e in events],
                 "reasons": [e.reason for e in events if e.reason],
+                "event_syncs": [e.syncs for e in events],
+                "span_paths": [s.attrs.get("path") for s in spans],
+                "span_syncs": [s.syncs for s in spans],
                 "rows": len(rows),
             })
-        evidence.append({"sql": sql, "cold": runs[0], "warm": runs[1]})
+        evidence.append({"sql": sql, "cold": runs[0], "warm": runs[1],
+                         "traced": traced})
     return evidence
 
 
@@ -164,6 +183,22 @@ def compare(reports, evidence, inject_drift=False):
                         problems.append(
                             f"{sight} runtime reason {rt_reason!r} is not "
                             f"explained by static codes {rep.reasons}")
+        # trace-layer parity (independent of the drift injection: it is
+        # runtime-vs-runtime): every streamed scan's span must report the
+        # exact syncs its StreamEvent charged — zero-added-sync tracing,
+        # measured, not assumed
+        if ev.get("traced"):
+            for sight in ("cold", "warm"):
+                r = ev[sight]
+                if r["span_paths"] != r["paths"] or \
+                        r["span_syncs"] != r["event_syncs"]:
+                    problems.append(
+                        f"{sight} trace spans "
+                        f"{list(zip(r['span_paths'], r['span_syncs']))} != "
+                        f"StreamEvents "
+                        f"{list(zip(r['paths'], r['event_syncs']))}: the "
+                        "trace layer is paying for (or mis-windowing) its "
+                        "own metrics")
         if not ev["warm"]["rows"]:
             problems.append("A/B template unexpectedly returned no rows")
         if problems:

@@ -1,10 +1,17 @@
 # Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
 """Per-query host-sync site profiler (dev tool for DESIGN.md items 2/4).
 
-Runs queries from a generated stream on the CPU backend with every
-``ops.host_read`` fetch attributed to its call site, and prints a per-query
-histogram of sync sites — the measurement behind the sync-tail reduction
-work (which sites dominate q9/q14/q58/q77/q83).
+Runs queries from a generated stream on the CPU backend and prints a
+per-query histogram of sync sites — the measurement behind the sync-tail
+reduction work (which sites dominate q9/q14/q58/q77/q83).
+
+Built on the obs layer's first-class ``ops.host_read`` site attribution
+(every sync-charging fetch emits a :class:`nds_tpu.obs.trace.SyncSite`
+naming its engine call site) instead of the old ``E.host_read``
+monkeypatch, which double-counted nested fetches: a fetch that re-entered
+``host_read`` (e.g. a direct count fallback inside a batched resolve)
+charged its syncs to BOTH frames. The first-class counters attribute each
+sync to exactly one site.
 
 Usage: JAX_PLATFORMS=cpu python tools/sync_profile.py query9 query83 ...
 """
@@ -12,47 +19,41 @@ Usage: JAX_PLATFORMS=cpu python tools/sync_profile.py query9 query83 ...
 import collections
 import os
 import sys
-import traceback
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# a sync-heavy eager loop can emit one SyncSite per charged read; the
+# default per-thread ring (8192) would evict the oldest sites and the
+# histogram would silently undercount — profile with a deep ring
+os.environ.setdefault("NDS_TPU_TRACE_RING", "1048576")
 
 SCALE = os.environ.get("NDS_BENCH_SCALE", "0.01")
+
+
+def site_histogram(records) -> "collections.Counter":
+    """(tag, site) -> syncs over one drained trace-record list."""
+    from nds_tpu.obs.trace import SyncSite
+    sites = collections.Counter()
+    for r in records:
+        if isinstance(r, SyncSite):
+            sites[(r.tag, r.site)] += r.syncs
+    return sites
 
 
 def main():
     wanted = sys.argv[1:]
     from nds_tpu.engine import ops as E
     from nds_tpu.engine.session import Session
-    from nds_tpu.schema import get_schemas
+    from nds_tpu.obs import trace as obs_trace
     from nds_tpu.power import gen_sql_from_stream
+    from nds_tpu.schema import get_schemas
 
-    sites = collections.Counter()
-    real_read = E.host_read
-
-    def traced_read(tag, fetch):
-        def wrapped():
-            before = E.sync_count()
-            out = fetch()
-            if E.sync_count() != before:
-                # attribute to the closest engine frame above ops.py
-                for fr in reversed(traceback.extract_stack()[:-2]):
-                    if "/nds_tpu/" in fr.filename and \
-                            not fr.filename.endswith("ops.py"):
-                        where = f"{os.path.basename(fr.filename)}:" \
-                                f"{fr.lineno}:{fr.name}"
-                        break
-                else:
-                    where = "?"
-                sites[(tag, where)] += E.sync_count() - before
-            return out
-        return real_read(tag, wrapped)
-
-    # every call site resolves host_read/timed_read through the ops module
-    # attribute at call time, so one rebind profiles them all
-    E.host_read = traced_read
+    if not obs_trace.on():
+        print("NDS_TPU_TRACE is off; sync-site attribution needs the "
+              "trace layer", file=sys.stderr)
+        obs_trace.set_enabled(True)
 
     pq = os.path.join(REPO, ".bench_cache", f"sf{SCALE}_parquet")
     stream = None
@@ -73,10 +74,16 @@ def main():
 
     for name in (wanted or queries):
         sql = queries[name]
-        sites.clear()
+        obs_trace.drain_spans()          # table-setup leftovers
         s0 = E.sync_count()
         sess.sql(sql).collect()
         used = E.sync_count() - s0
+        records = obs_trace.drain_spans()
+        if len(records) >= obs_trace._RING_MAX:
+            print(f"  !! trace ring full ({obs_trace._RING_MAX} records): "
+                  "oldest sync sites evicted — histogram is a floor; "
+                  "raise NDS_TPU_TRACE_RING", file=sys.stderr)
+        sites = site_histogram(records)
         print(f"\n== {name}: {used} syncs ==")
         for (tag, where), n in sites.most_common():
             print(f"  {n:3d}  {tag:12s} {where}")

@@ -1,0 +1,164 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Aggregate a --trace-dir of per-query Chrome traces into the phase
+table PERF.md needs.
+
+Reads every ``*.trace.json`` a driver wrote (``nds_power.py --trace-dir``
+/ ``NDS_BENCH_TRACE_DIR``) and prints:
+
+1. the per-query phase breakdown — self-time per phase (a parent span's
+   time minus its children), host-sync count, and the compile-vs-drive
+   split of the streamed chunk pipeline;
+2. the top sync-charging host-read sites across the run (the first-class
+   ``ops.host_read`` call-site tags — which engine lines pay the round
+   trips);
+3. the eager-fallback cost ranking by reason — the measured worklist for
+   ROADMAP's streamability widening (each line is wall time + syncs a
+   query paid because the compiled pipeline rejected it).
+
+Usage: python tools/trace_report.py TRACE_DIR [--top N]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import Counter, defaultdict
+
+# phase columns of the breakdown table, in pipeline order; everything
+# else (query/stream umbrellas, uncovered wall) folds into "other"
+PHASES = ("plan", "replay.record", "replay.compile", "replay.drive",
+          "stream.record", "stream.compile", "stream.prefetch",
+          "stream.drive", "stream.eager", "stream.materialize",
+          "materialize")
+
+
+def self_times(events):
+    """Per-event self duration: each X event's ``dur`` minus the dur of
+    its directly nested children (ts/dur containment on one thread)."""
+    spans = [dict(e) for e in events if e.get("ph") == "X"]
+    spans.sort(key=lambda e: (e["ts"], -e["dur"]))
+    stack = []
+    for e in spans:
+        e["self"] = e["dur"]
+        while stack and stack[-1]["ts"] + stack[-1]["dur"] <= e["ts"]:
+            stack.pop()
+        e["top"] = not stack          # not contained in any other span
+        if stack:
+            stack[-1]["self"] -= e["dur"]
+        stack.append(e)
+    return spans
+
+
+def load_trace(path):
+    with open(path) as f:
+        doc = json.load(f)
+    query = (doc.get("nds") or {}).get("query") or \
+        os.path.basename(path).split(".trace.json")[0]
+    return query, doc.get("traceEvents") or []
+
+
+def report(trace_dir, top=10):
+    """Aggregate a trace dir; returns the printable lines."""
+    files = sorted(glob.glob(os.path.join(trace_dir, "*.trace.json")))
+    if not files:
+        return [f"# no *.trace.json files under {trace_dir}"]
+    per_query = {}
+    sites = Counter()
+    site_tag = {}
+    fallbacks = defaultdict(lambda: {"queries": 0, "ms": 0.0, "syncs": 0})
+    for path in files:
+        query, events = load_trace(path)
+
+        def is_sync(e):
+            return e.get("cat") == "sync" or e["name"].startswith("sync:")
+
+        query_syncs = 0
+        for e in events:
+            if e.get("ph") == "X" and is_sync(e):
+                args = e.get("args") or {}
+                site = args.get("site", "?")
+                sites[site] += args.get("syncs", 0)
+                query_syncs += args.get("syncs", 0)
+                site_tag.setdefault(site, e["name"].split("sync:")[-1])
+        # sync slices are excluded from the span tree: their blocked time
+        # belongs to the phase span that paid it, not to an "other" row
+        spans = self_times([e for e in events if not is_sync(e)])
+        row = {"total_ms": 0.0, "syncs": 0, "phases": defaultdict(float)}
+        for e in spans:
+            name = e["name"]
+            args = e.get("args") or {}
+            row["phases"][name if name in PHASES else "other"] += \
+                e["self"] / 1e3
+            if name == "stream" and args.get("path") == "eager":
+                fb = fallbacks[args.get("reason", "?")]
+                fb["queries"] += 1
+                fb["ms"] += e["dur"] / 1e3
+                fb["syncs"] += args.get("syncs", 0)
+        # wall from the top-level (non-contained) spans only, so nested
+        # phases never double-count into the query total; syncs from the
+        # attributed sync-site slices — each charged sync appears on
+        # exactly one slice, including syncs paid BETWEEN spans that no
+        # top-level span's delta would cover
+        tops = [e for e in spans if e["top"]]
+        row["total_ms"] = sum(e["dur"] for e in tops) / 1e3
+        row["syncs"] = query_syncs
+        per_query[query] = row
+
+    used = [p for p in PHASES
+            if any(r["phases"].get(p) for r in per_query.values())]
+    if any(r["phases"].get("other") for r in per_query.values()):
+        used.append("other")
+    lines = [f"# trace report: {len(per_query)} queries from {trace_dir}",
+             "",
+             "| query | total ms | " + " | ".join(used) +
+             " | host syncs |",
+             "|---" * (len(used) + 3) + "|"]
+    for q in sorted(per_query):
+        r = per_query[q]
+        cells = " | ".join(f"{r['phases'].get(p, 0.0):.1f}" for p in used)
+        lines.append(f"| {q} | {r['total_ms']:.1f} | {cells} | "
+                     f"{r['syncs']} |")
+    comp = sum(r["phases"].get("stream.compile", 0.0)
+               for r in per_query.values())
+    drive = sum(r["phases"].get("stream.drive", 0.0)
+                for r in per_query.values())
+    if comp or drive:
+        ratio = f"{comp / drive:.2f}" if drive else "inf"
+        lines.append(f"# streamed pipeline compile/drive ratio: {ratio} "
+                     f"({comp:.1f} ms compile / {drive:.1f} ms drive)")
+    lines.append("")
+    lines.append(f"# top host-sync sites (of {sum(sites.values())} "
+                 "attributed syncs)")
+    for site, n in sites.most_common(top):
+        lines.append(f"  {n:4d}  {site_tag.get(site, '?'):<12} {site}")
+    lines.append("")
+    if fallbacks:
+        lines.append("# eager-fallback cost by reason (the streamability "
+                     "widening worklist)")
+        ranked = sorted(fallbacks.items(),
+                        key=lambda kv: kv[1]["ms"], reverse=True)
+        for reason, fb in ranked:
+            lines.append(f"  {fb['ms']:9.1f} ms  {fb['syncs']:4d} syncs  "
+                         f"{fb['queries']:3d} scans  {reason}")
+    else:
+        lines.append("# no eager-fallback streamed scans in this run")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="aggregate a --trace-dir into the per-phase "
+        "breakdown table (PERF.md), top sync sites and fallback costs")
+    ap.add_argument("trace_dir", help="directory of *.trace.json files "
+                    "written by nds_power.py --trace-dir")
+    ap.add_argument("--top", type=int, default=10,
+                    help="sync sites to list (default 10)")
+    args = ap.parse_args(argv)
+    for ln in report(args.trace_dir, top=args.top):
+        print(ln)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
