@@ -228,6 +228,7 @@ def run_server():
                 result["streamedScans"] = [
                     {"table": e.where, "chunks": e.chunks,
                      "syncs": e.syncs, "path": e.path,
+                     **({"rows": e.rows} if e.rows >= 0 else {}),
                      **({"reason": e.reason} if e.reason else {})}
                     for e in stream_events]
             if trace_records:

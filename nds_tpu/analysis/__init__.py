@@ -14,11 +14,16 @@ with no device in the loop:
   ``nds_tpu/``: host syncs inside hot-path loops, Python ``if`` on
   tracer-valued parameters, unhashable/unbounded jit-cache keys,
   ``time.time()`` inside jitted regions.
+* :mod:`nds_tpu.analysis.exec_audit` — abstract interpreter over the
+  planner's decomposition: execution-path classification (compiled-stream
+  / eager-fallback / device-resident) and static host-sync bounds.
+* :mod:`nds_tpu.analysis.mem_audit` — per-statement peak-HBM byte bounds
+  and the stream-accumulator proofs ``engine/stream.py`` sizes from.
 * :mod:`nds_tpu.analysis.driver_audit` — driver-level hygiene for the
   top-level CLIs and ``tools/``: swallowed exceptions, shell-injection
   surfaces, file handles opened outside context managers.
 
-``tools/lint.py`` runs all three and gates on new findings against the
+``tools/lint.py`` runs all five and gates on new findings against the
 checked-in :data:`BASELINE_PATH` (accepted pre-existing findings); code-lint
 findings are suppressible in-source with ``# nds-lint: ignore[rule]``.
 """

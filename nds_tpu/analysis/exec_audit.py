@@ -31,7 +31,11 @@ with no device in the loop, answers for every template:
      (overflow ⇒ eager rerun).
    * ``accumulator-overflow`` — same mechanism without the outer-join
      context: a bare streamed scan (no filter, no join) keeps every chunk
-     row, exceeding ``NDS_TPU_STREAM_ACC_ROWS`` at >HBM scale.
+     row AND the static memory model (:mod:`nds_tpu.analysis.mem_audit`)
+     cannot prove the survivor accumulator fits the HBM capacity model.
+     A bare scan whose proven bound FITS is ``compiled-stream``: the
+     runtime sizes the accumulator from the same proof, so the overflow
+     rerun can never fire (lockstep rule — both sides changed together).
    * ``non-invariant-graph`` — conservative catch-all for graphs the model
      cannot prove chunk-invariant (currently: a chunked scan bound by a
      statement shape outside the SELECT/join-graph forms modeled here).
@@ -104,6 +108,9 @@ SYNC_BUDGET = 6
 # from arrow.nbytes, which the audit cannot see — this set is the static
 # stand-in and is parameterizable per ExecAuditor).
 DEFAULT_STREAMED = ("catalog_sales", "inventory", "store_sales", "web_sales")
+# (round 9 corpus: 74 compiled-stream / 22 eager-fallback / 7
+# device-resident — the memory proof retired every provable
+# accumulator-overflow fallback)
 
 # descending resident-size rank of the streamable facts: when a graph binds
 # several chunked scans the planner streams the LARGEST (by nbytes) and
@@ -217,6 +224,7 @@ class _Cost:
         self.per_chunk = 0
         self.first_sight = 0
         self.scans: list = []
+        self.needed = None               # statement pruning set (mem model)
 
 
 def _children(e):
@@ -315,7 +323,7 @@ class ExecAuditor:
     table, matching a session that loads them as base scans."""
 
     def __init__(self, catalog: dict | None = None,
-                 streamed=None, base_tables=None):
+                 streamed=None, base_tables=None, mem_model=None):
         if catalog is None:
             catalog = {
                 t: {f.name.lower(): type_class(f.type) for f in fields}
@@ -325,6 +333,11 @@ class ExecAuditor:
                             else streamed)
         self.base_tables = set(catalog if base_tables is None
                                else base_tables)
+        if mem_model is None:
+            # lazy: mem_audit imports this module's AST helpers at top
+            from nds_tpu.analysis.mem_audit import MemModel
+            mem_model = MemModel()
+        self.mem = mem_model
 
     # -- entry points -------------------------------------------------------
 
@@ -337,6 +350,12 @@ class ExecAuditor:
             return ExecReport(file, query, CLASS_UNKNOWN, (R_PARSE,),
                               detail=str(e))
         cost = _Cost()
+        # the statement's referenced-column set (planner projection
+        # pushdown mirror): the accumulator-fit test below prices only
+        # the columns a bare streamed scan would actually upload
+        from nds_tpu.analysis.mem_audit import statement_needed_names
+        cost.needed = statement_needed_names(
+            stmt, {t: list(cols) for t, cols in self.catalog.items()})
         env = {name: (set(cols), name in self.base_tables)
                for name, cols in self.catalog.items()}
         try:
@@ -743,7 +762,15 @@ class ExecAuditor:
             bool(filters[keep]) or \
             any(keep in self._owners(c, parts) for c in residual + subq)
         if not incident:
-            reasons.append(R_OUTER if outer_ctx else R_OVERFLOW)
+            if outer_ctx:
+                reasons.append(R_OUTER)
+            elif not self.mem.bare_scan_fits(parts[keep].source,
+                                             cost.needed):
+                # the survivor accumulator keeps every chunk row and the
+                # memory proof cannot admit it — overflow rerun at scale.
+                # A bare scan whose proven bound FITS streams compiled:
+                # the runtime sizes the accumulator from the same proof.
+                reasons.append(R_OVERFLOW)
         compiled = not reasons
 
         verdicts = []

@@ -1,0 +1,1166 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Static HBM-footprint auditor: prove per-statement memory bounds on host.
+
+The streaming executor used to guard device memory with a *guess*: a global
+survivor-accumulator ceiling (``NDS_TPU_STREAM_ACC_ROWS``, default 2^23)
+plus a device-side overflow flag that throws away a whole streamed run and
+re-executes eagerly. This module is the third abstract interpreter over the
+planner's decomposition — sibling to :mod:`plan_audit` (name/type
+resolution) and :mod:`exec_audit` (control path + sync bounds) — and
+answers, host-only and with no device in the loop, for every statement of
+every template:
+
+1. **How many bytes can it ever hold on device?** A conservative
+   *peak-HBM byte bound* composed from:
+
+   * **dtype widths** from :mod:`nds_tpu.schema` through the planner's
+     column pruning (only columns the statement references anywhere are
+     ever uploaded; a ``SELECT *`` disables pruning, conservatively, for
+     the whole statement). Widths mirror the device representation of
+     :mod:`nds_tpu.engine.column`: int32/date = 4 B, int64/double and
+     scaled-decimal = 8 B, strings = 4 B dictionary codes (value tables
+     live on host), plus 1 B validity per row — exactly the shapes
+     ``ChunkedTable.padded_chunks`` materializes.
+   * **cardinality bounds propagated through joins**: a join batch whose
+     keys cover the non-streamed side's declared (composite) primary key
+     on a pristine base-table scan is unique on that side — output rows
+     stay bounded by the fact side. Every other batch is bounded by the
+     stream-bounds pair bucket the runtime enforces
+     (probe-bucket × ``NDS_TPU_STREAM_FANOUT``; inside the compiled
+     pipeline exceeding it raises the device overflow flag, so the bound
+     is *enforced*, not estimated). Unconnected components multiply
+     (cartesian layout — exact product).
+   * **filters**: no reduction assumed (a filter may keep every row).
+   * **group-bys**: output bounded by the product of the group keys'
+     value domains (a base-table column's domain is at most its table's
+     row bound) clamped at input rows.
+
+2. **How large can a streamed scan's survivor accumulator grow?** The
+   per-scan *accumulator row bound*: ``min(n_chunks × per-chunk output
+   bucket, bucket_len(table rows) × fanout^k)`` where ``k`` counts the
+   join batches that may fan out survivor rows
+   (:func:`stream_graph_fanout`). This is the number the runtime now
+   **sizes the accumulator from** (``engine/stream.py``): a statement
+   whose proven bound fits the HBM capacity model can never trip the
+   overflow rerun, and `exec_audit` reclassifies its former
+   ``accumulator-overflow`` fallback to ``compiled-stream`` in lockstep.
+
+The capacity model is ``NDS_TPU_HBM_BYTES`` (default 16 GiB, one v5-lite
+chip); the cardinality model is a conservative SF10 row-bound table
+(:data:`DEFAULT_ROW_BOUNDS`), both parameterizable per :class:`MemModel`.
+
+**The model is a checked contract.** ``tools/mem_audit_diff.py`` replays
+the ``test_synccount`` A/B templates through the real engine and fails
+when a measured survivor count or materialized byte volume ever exceeds
+the static bound (soundness), and proves the gate can fail via
+``--inject-drift`` — the same lockstep rule that ties ``exec_audit`` to
+the executor's routing. **When you change the planner's join bounds,
+``ChunkedTable`` chunk shapes, or the schema widths, update this model in
+the same PR**; ``tests/test_analysis.py`` runs both in tier-1.
+
+The lint gate (``hbm-capacity``, ``tools/lint.py``) fails any
+device-resident statement whose peak bound exceeds the configured
+capacity, and any streamed statement whose accumulator bound exceeds it;
+``--mem-report`` prints the per-statement table.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from nds_tpu.analysis import Finding
+from nds_tpu.analysis.exec_audit import (_children, _column_refs,
+                                         _conjuncts_of, _has_subquery)
+from nds_tpu.analysis.plan_audit import _single_row_query
+from nds_tpu.queries import (TEMPLATE_DIR, instantiate_template,
+                             list_templates, load_template)
+from nds_tpu.schema import (COMPOSITE_PRIMARY_KEYS, PRIMARY_KEYS,
+                            get_schemas, is_decimal, is_string)
+from nds_tpu.sql import ast as A
+from nds_tpu.sql.parser import ParseError, parse
+
+# HBM capacity model: the proof budget every per-statement bound is gated
+# against (and the admission test for proof-sized stream accumulators).
+# Default: one v5-lite chip's 16 GiB.
+DEFAULT_HBM_BYTES = 16 << 30
+
+
+def hbm_capacity_bytes() -> int:
+    """The configured device-memory capacity (``NDS_TPU_HBM_BYTES``)."""
+    return int(os.environ.get("NDS_TPU_HBM_BYTES", str(DEFAULT_HBM_BYTES)))
+
+
+# Conservative SF10 row-count upper bounds (TPC-DS spec scaling, rounded
+# UP — the audit must never under-bound a cardinality). The static
+# stand-in for the arrow row counts a live session would know exactly;
+# parameterizable per MemModel (tools/mem_audit_diff.py passes the toy
+# session's real counts).
+DEFAULT_ROW_BOUNDS = {
+    "call_center": 30,
+    "catalog_page": 12_100,
+    "catalog_returns": 1_500_000,
+    "catalog_sales": 14_500_000,
+    "customer": 500_000,
+    "customer_address": 250_000,
+    "customer_demographics": 1_920_800,
+    "date_dim": 73_049,
+    "household_demographics": 7_200,
+    "income_band": 20,
+    "inventory": 133_200_000,
+    "item": 102_000,
+    "promotion": 500,
+    "reason": 45,
+    "ship_mode": 20,
+    "store": 102,
+    "store_returns": 2_900_000,
+    "store_sales": 28_900_000,
+    "time_dim": 86_400,
+    "warehouse": 10,
+    "web_page": 200,
+    "web_returns": 800_000,
+    "web_sales": 7_300_000,
+    "web_site": 42,
+}
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(int(n) - 1, 1).bit_length() if n > 2 else 2
+
+
+def _bucket(n: int) -> int:
+    """Mirror of ``ops.bucket_len``: smallest power-of-two capacity >= n
+    with the same ``NDS_TPU_MIN_BUCKET`` floor — the audit's row bounds
+    must round exactly like the engine's physical buckets."""
+    floor = _pow2_ceil(int(os.environ.get("NDS_TPU_MIN_BUCKET", "16")))
+    if n <= floor:
+        return floor
+    return 1 << (int(n) - 1).bit_length()
+
+
+def type_width(t: str) -> int:
+    """Device bytes per row of one column of canonical type ``t``,
+    validity byte included — mirrors ``engine/column.py``'s lowering
+    (int32/date -> int32, decimals -> scaled int64, strings -> int32
+    dictionary codes with a host-side value table)."""
+    if is_string(t):
+        return 4 + 1
+    if is_decimal(t):
+        return 8 + 1
+    if t in ("int32", "date"):
+        return 4 + 1
+    return 8 + 1                       # int64 / double / unknown
+
+
+# ---------------------------------------------------------------------------
+# shared survivor-bound core (used by engine/stream.py at pipeline build)
+# ---------------------------------------------------------------------------
+
+
+def _owns_key(colset, ref: A.ColumnRef) -> str | None:
+    """Bare column name when a part whose lowercase ``alias.col`` key set
+    is ``colset`` provides ``ref`` — mirroring the planner's qualified /
+    suffix-match resolution over its internal column names."""
+    name = ref.name.lower()
+    if ref.table:
+        return name if f"{ref.table.lower()}.{name}" in colset else None
+    for c in colset:
+        if c == name or c.endswith("." + name):
+            return name
+    return None
+
+
+def _equi_sides(c, part_cols):
+    """``(li, ri, lkey, rkey)`` when the conjunct is an equi edge between
+    two distinct parts: a plain ``col = col`` (bare key names returned),
+    or an expression-equi conjunct whose sides each live wholly in one
+    part (keys None — an expression can never cover a primary key)."""
+    if not (isinstance(c, A.BinaryOp) and c.op == "="):
+        return None
+    if isinstance(c.left, A.ColumnRef) and isinstance(c.right, A.ColumnRef):
+        li = ri = None
+        lk = rk = None
+        for i, cols in enumerate(part_cols):
+            if li is None:
+                got = _owns_key(cols, c.left)
+                if got:
+                    li, lk = i, got
+            if ri is None:
+                got = _owns_key(cols, c.right)
+                if got:
+                    ri, rk = i, got
+        if li is not None and ri is not None and li != ri:
+            return li, ri, lk, rk
+        return None
+
+    def side_owner(e):
+        refs = _column_refs(e)
+        if not refs:
+            return None
+        owner = None
+        for r in refs:
+            cands = [i for i, cols in enumerate(part_cols)
+                     if _owns_key(cols, r)]
+            if len(cands) != 1:
+                return None
+            if owner is None:
+                owner = cands[0]
+            elif owner != cands[0]:
+                return None
+        return owner
+
+    li, ri = side_owner(c.left), side_owner(c.right)
+    if li is not None and ri is not None and li != ri:
+        return li, ri, None, None
+    return None
+
+
+def _table_pk(src: str | None):
+    if not src:
+        return None
+    pk = COMPOSITE_PRIMARY_KEYS.get(src)
+    if pk is None and src in PRIMARY_KEYS:
+        pk = (PRIMARY_KEYS[src],)
+    return pk
+
+
+def _batch_unique_side(part_cols, sources, keep, a, b, batch) -> bool:
+    """True when one side of the (a, b) edge batch is unique on its join
+    keys: the side is a pristine base-table scan whose bare key-name set
+    covers its declared (composite) primary key. When the batch touches
+    the streamed slot (``keep``), only the OTHER side counts — per-chunk
+    multiplicity is bounded by the non-chunk side's uniqueness, and the
+    executor masks chunk-side PK plans anyway (their host key ranges
+    would bake chunk data into the chunk-invariant program)."""
+    cands = [s for s in (a, b) if s != keep] if keep in (a, b) else [a, b]
+    for side in cands:
+        pk = _table_pk(sources[side])
+        if pk is None:
+            continue
+        keys = set()
+        for (li, ri, lk, rk) in batch:
+            k = lk if li == side else (rk if ri == side else None)
+            if k is not None:
+                keys.add(k)
+        if keys >= set(pk):
+            return True
+    return False
+
+
+def stream_graph_fanout(part_cols, sources, keep, conjuncts):
+    """Conservative survivor-multiplicity exponent ``k`` of a streamed
+    join graph, or None when the multiplicity is unprovable.
+
+    ``part_cols`` is the per-part set of lowercase ``alias.col`` column
+    keys, ``sources`` the per-part pristine catalog table name (None for
+    derived relations), ``keep`` the streamed part's index, ``conjuncts``
+    the join predicates + WHERE conjuncts (AST expressions).
+
+    The survivor rows of the whole streamed graph are then bounded by
+    ``bucket_len(streamed table rows) × fanout^k``: each of the ``k``
+    join batches with no unique (PK-covered) side is clamped at runtime
+    by the stream-bounds pair bucket (probe bucket × fanout, device
+    overflow flag past it), and every unique batch keeps per-row
+    multiplicity at <= 1. Returns None when a conjunct carries a subquery
+    (the trace diverges — the executor falls back eager) or when some
+    part is not connected to the streamed slot by equi edges (cartesian
+    layout: a chunk-data-dependent host read, same fallback)."""
+    n = len(part_cols)
+    batches: dict = {}
+    for c in conjuncts:
+        if _has_subquery(c):
+            return None
+        e = _equi_sides(c, part_cols)
+        if e is None:
+            # single-part filter, correlation, or a cross-part non-equi
+            # residual: applied to joined rows, never grows them
+            continue
+        li, ri, lk, rk = e
+        batches.setdefault(tuple(sorted((li, ri))), []).append(e)
+
+    parent = list(range(n))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for (a, b) in batches:
+        parent[find(a)] = find(b)
+    if n and any(find(i) != find(keep) for i in range(n)):
+        return None
+    k = 0
+    for (a, b), batch in batches.items():
+        if not _batch_unique_side(part_cols, sources, keep, a, b, batch):
+            k += 1
+    return k
+
+
+def _deep_children(e):
+    """Every AST expression nested in ``e``, reached through arbitrary
+    dataclass / list / tuple containers (unlike ``exec_audit._children``
+    this descends into non-Expr dataclasses such as WindowSpec, whose
+    partition/order expressions the pruning model must see — a missed
+    reference would UNDER-bound a width)."""
+
+    def rec(v):
+        if isinstance(v, A.Expr):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                yield from rec(x)
+        elif hasattr(v, "__dataclass_fields__"):
+            for f in vars(v).values():
+                yield from rec(f)
+
+    if hasattr(e, "__dataclass_fields__"):
+        for f in vars(e).values():
+            yield from rec(f)
+
+
+def structural_row_bound(rows: int, k: int, fanout: int) -> int:
+    """``bucket_len(rows) × fanout^k`` — the structural term of the
+    survivor proof. ONE definition shared by :meth:`MemModel.acc_row_bound`
+    (the audit) and ``engine/stream.py._proved_row_bound`` (the runtime
+    accumulator sizing), so the two can never drift apart."""
+    return _bucket(max(int(rows), 1)) * (int(fanout) ** int(k))
+
+
+def statement_needed_names(stmt, catalog_cols: dict | None = None) \
+        -> set | None:
+    """Bare lowercase column names the statement references anywhere —
+    the audit's mirror of the planner's projection pushdown
+    (``Planner._collect_needed_names``) — or None when pruning is unsafe.
+
+    ``SELECT *`` is resolved SCOPED, like the planner: a star over a
+    derived table (CTE or FROM-subquery) needs nothing new (its inner
+    projection is explicit and walked); a star over a catalog table adds
+    that table's full column set; only a star over an unresolvable name
+    disables pruning. ``catalog_cols`` maps table -> column names
+    (default: the TPC-DS schema)."""
+    if catalog_cols is None:
+        catalog_cols = {t: [f.name for f in fields]
+                        for t, fields in get_schemas(True).items()}
+    names: set = set()
+    disabled = [False]
+
+    def add_table(name):
+        cols = catalog_cols.get(name)
+        if cols is None:
+            disabled[0] = True
+        else:
+            names.update(c.lower() for c in cols)
+
+    def rel_entries(f, out):
+        """(alias, catalog name | None-for-derived) per FROM leaf."""
+        if isinstance(f, A.TableRef):
+            out.append(((f.alias or f.name).lower(), f.name.lower()))
+        elif isinstance(f, A.SubqueryRef):
+            out.append((f.alias.lower(), None))
+        elif isinstance(f, A.Join):
+            rel_entries(f.left, out)
+            rel_entries(f.right, out)
+        elif isinstance(f, A.Query):
+            rel_entries(getattr(f.body, "from_", None), out)
+
+    def walk_expr(e, ctes, rels):
+        if isinstance(e, A.Star):
+            qual = e.table and e.table.lower()
+            if qual is None:
+                for _alias, src in rels:
+                    if src is not None and src not in ctes:
+                        add_table(src)
+                if not rels:
+                    disabled[0] = True
+            else:
+                hit = [src for alias, src in rels
+                       if alias == qual or src == qual]
+                if hit and hit[0] is not None and hit[0] not in ctes:
+                    add_table(hit[0])
+                elif (hit and (hit[0] is None or hit[0] in ctes)) \
+                        or qual in ctes:
+                    pass               # star over a derived relation
+                    #                    (subquery alias, CTE name, or an
+                    #                    ALIAS over a CTE reference)
+                elif qual in catalog_cols:
+                    add_table(qual)
+                else:
+                    disabled[0] = True
+            return
+        if isinstance(e, A.ColumnRef):
+            names.add(e.name.lower())
+            return
+        if isinstance(e, (A.ScalarSubquery, A.InSubquery, A.Exists,
+                          A.QuantifiedCompare)):
+            walk_query(e.query, ctes)
+            if isinstance(e, (A.InSubquery, A.QuantifiedCompare)):
+                walk_expr(e.expr, ctes, rels)
+            return
+        for c in _deep_children(e):
+            walk_expr(c, ctes, rels)
+
+    def walk_from(f, ctes, rels):
+        if isinstance(f, A.SubqueryRef):
+            walk_query(f.query, ctes)
+        elif isinstance(f, A.Join):
+            walk_from(f.left, ctes, rels)
+            walk_from(f.right, ctes, rels)
+            if f.condition is not None:
+                walk_expr(f.condition, ctes, rels)
+        elif isinstance(f, A.Query):
+            walk_from(getattr(f.body, "from_", None), ctes, rels)
+
+    def walk_sel(sel, ctes):
+        rels = []
+        rel_entries(sel.from_, rels)
+        walk_from(sel.from_, ctes, rels)
+        for item in sel.items:
+            walk_expr(item.expr, ctes, rels)
+        if sel.where is not None:
+            walk_expr(sel.where, ctes, rels)
+        if sel.group_by is not None:
+            for e in sel.group_by.exprs:
+                walk_expr(e, ctes, rels)
+        if sel.having is not None:
+            walk_expr(sel.having, ctes, rels)
+
+    def walk_body(b, ctes):
+        if isinstance(b, A.SetOp):
+            walk_body(b.left, ctes)
+            walk_body(b.right, ctes)
+        elif isinstance(b, A.Query):
+            walk_query(b, ctes)
+        else:
+            walk_sel(b, ctes)
+
+    def walk_query(q, ctes):
+        ctes = set(ctes)
+        for cname, cq in q.ctes:
+            walk_query(cq, ctes)
+            ctes.add(cname.lower())
+        walk_body(q.body, ctes)
+        for ent in q.order_by:
+            walk_expr(ent[0], ctes, [])
+
+    if isinstance(stmt, A.Query):
+        walk_query(stmt, set())
+    elif isinstance(stmt, (A.InsertInto, A.CreateTempView)):
+        walk_query(stmt.query, set())
+    elif isinstance(stmt, A.DeleteFrom) and stmt.where is not None:
+        walk_expr(stmt.where, set(), [])
+    return None if disabled[0] else names
+
+
+# ---------------------------------------------------------------------------
+# the capacity / cardinality model
+# ---------------------------------------------------------------------------
+
+
+class MemModel:
+    """Capacity + cardinality model every bound is computed against.
+
+    ``row_bounds`` maps catalog table -> row upper bound (default: the
+    conservative SF10 table); ``capacity_bytes`` is the HBM budget
+    (``NDS_TPU_HBM_BYTES``); ``fanout``/``chunk_rows``/``acc_ceiling``
+    mirror the executor's env knobs, read at construction time so a model
+    built after the environment changed sees the change (the same
+    build-time discipline ``engine/stream.py`` follows)."""
+
+    def __init__(self, row_bounds=None, capacity_bytes=None, fanout=None,
+                 chunk_rows=None, acc_ceiling="env", catalog=None):
+        self.row_bounds = dict(DEFAULT_ROW_BOUNDS if row_bounds is None
+                               else row_bounds)
+        self.capacity_bytes = (hbm_capacity_bytes() if capacity_bytes is None
+                               else int(capacity_bytes))
+        self.fanout = _pow2_ceil(int(
+            os.environ.get("NDS_TPU_STREAM_FANOUT", "4"))
+            if fanout is None else int(fanout))
+        self.chunk_rows = int(
+            os.environ.get("NDS_TPU_STREAM_CHUNK_ROWS", str(1 << 22))
+            if chunk_rows is None else chunk_rows)
+        if acc_ceiling == "env":
+            env = os.environ.get("NDS_TPU_STREAM_ACC_ROWS")
+            acc_ceiling = int(env) if env else None
+        self.acc_ceiling = acc_ceiling
+        if catalog is None:
+            catalog = {
+                t: {f.name.lower(): type_width(f.type) for f in fields}
+                for t, fields in get_schemas(use_decimal=True).items()}
+        self.widths = catalog              # table -> {col -> bytes/row}
+
+    def table_rows(self, name: str) -> int | None:
+        return self.row_bounds.get(name)
+
+    def pruned_width(self, table: str, needed: set | None) -> int:
+        """Bytes per row of ``table`` after the planner's column pruning
+        (``needed`` = names the statement references; None disables
+        pruning). An empty intersection keeps every column, exactly like
+        the planner (it never prunes to zero columns)."""
+        cols = self.widths.get(table, {})
+        if not cols:
+            return 9                       # unknown table: one wide column
+        if needed is not None:
+            kept = {c: w for c, w in cols.items() if c in needed}
+            if kept and len(kept) < len(cols):
+                cols = kept
+        return sum(cols.values())
+
+    def chunk_cap(self) -> int:
+        return _bucket(self.chunk_rows)
+
+    def acc_row_bound(self, stream_rows: int, k: int) -> int:
+        """Proven survivor-row bound of one streamed graph: the tighter
+        of the per-chunk-bucket sum and the structural
+        ``bucket_len(rows) × fanout^k`` bound (both sound; the runtime
+        sizes its accumulator from the same minimum)."""
+        mult = self.fanout ** k
+        n_chunks = max(1, math.ceil(stream_rows / self.chunk_rows))
+        base = n_chunks * self.chunk_cap() * mult
+        return min(base, structural_row_bound(stream_rows, k, self.fanout))
+
+    def bare_scan_fits(self, table: str | None, needed: set | None) -> bool:
+        """Can a bare streamed scan of ``table`` (no filter, no join: the
+        survivor accumulator keeps every row) be proven to fit? True when
+        the proven accumulator bound fits the capacity model AND the env
+        ceiling (if one is set) admits the table's rows — exactly the
+        condition under which the runtime's proof-sized accumulator can
+        never trip the overflow rerun. This is the predicate that retires
+        ``accumulator-overflow`` fallbacks (`exec_audit` lockstep)."""
+        rows = self.row_bounds.get(table or "")
+        if rows is None:
+            return False
+        if self.acc_ceiling is not None and rows > self.acc_ceiling:
+            return False                   # hard ceiling: overflow certain
+        bound = self.acc_row_bound(rows, 0)
+        return bound * self.pruned_width(table, needed) \
+            <= self.capacity_bytes
+
+
+# ---------------------------------------------------------------------------
+# report types
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ScanBound:
+    """The proven memory fate of one >HBM streamed scan."""
+
+    alias: str
+    table: str
+    rows: int                  # streamed table row bound
+    fanout_k: int | None       # survivor-multiplicity exponent; None =
+    #                            unprovable (subquery / cartesian: the
+    #                            executor falls back eager there)
+    acc_rows: int | None       # proven accumulator row bound (provable)
+    acc_bytes: int | None      # acc_rows x streamed-graph row width
+    chunk_bytes: int = 0       # one padded chunk's bytes (x2 in flight)
+
+    @property
+    def provable(self) -> bool:
+        return self.fanout_k is not None
+
+
+@dataclass
+class MemReport:
+    """Peak-HBM byte bound of one template statement."""
+
+    file: str
+    query: str
+    mode: str                  # "streamed" | "device" | "unknown"
+    peak_bytes: int = 0
+    out_rows: int = 0          # statement output row bound (soundness-
+    #                            checked by tools/mem_audit_diff.py)
+    scans: tuple = ()          # ScanBounds, FROM order
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "file": self.file, "query": self.query, "mode": self.mode,
+            "peak_bytes": int(self.peak_bytes),
+            "out_rows": int(self.out_rows),
+            "scans": [{"alias": s.alias, "table": s.table,
+                       "rows": int(s.rows), "fanout_k": s.fanout_k,
+                       "acc_rows": None if s.acc_rows is None
+                       else int(s.acc_rows),
+                       "acc_bytes": None if s.acc_bytes is None
+                       else int(s.acc_bytes),
+                       "chunk_bytes": int(s.chunk_bytes),
+                       "provable": s.provable} for s in self.scans],
+            "detail": self.detail,
+        }
+
+
+class _MRel:
+    """One relation in the walk: row bound + per-column widths and value
+    domains, addressable by every alias the relation answers for (a
+    materialized outer join keeps both sides' aliases, exactly like the
+    planner's merged alias-qualified columns)."""
+
+    __slots__ = ("cols", "widths", "dom", "rows", "source", "chunked",
+                 "single_row")
+
+    def __init__(self, alias, widths: dict, rows: int, dom: dict | None =
+                 None, source=None, chunked=False, single_row=False):
+        a = alias.lower()
+        self.widths = {a: dict(widths)}
+        self.cols = {a: set(widths)}
+        self.dom = {a: dict(dom or {c: rows for c in widths})}
+        self.rows = int(rows)
+        self.source = source
+        self.chunked = chunked
+        self.single_row = single_row
+
+    @property
+    def alias(self) -> str:
+        return next(iter(self.cols))
+
+    @property
+    def width(self) -> int:
+        return sum(w for cols in self.widths.values()
+                   for w in cols.values())
+
+    def colset(self) -> set:
+        return {f"{a}.{c}" for a, cols in self.cols.items() for c in cols}
+
+    def owns(self, ref: A.ColumnRef) -> str | None:
+        name = ref.name.lower()
+        if ref.table:
+            t = ref.table.lower()
+            cols = self.cols.get(t)
+            return name if cols is not None and name in cols else None
+        for cols in self.cols.values():
+            if name in cols:
+                return name
+        return None
+
+    def col_width(self, ref) -> int:
+        name = ref.name.lower()
+        aliases = [ref.table.lower()] if ref.table else list(self.cols)
+        for a in aliases:
+            w = self.widths.get(a, {}).get(name)
+            if w is not None:
+                return w
+        return 9
+
+    def col_domain(self, ref) -> int:
+        name = ref.name.lower()
+        aliases = [ref.table.lower()] if ref.table else list(self.cols)
+        for a in aliases:
+            d = self.dom.get(a, {}).get(name)
+            if d is not None:
+                return d
+        return self.rows
+
+    def merged_with(self, other: "_MRel", rows: int) -> "_MRel":
+        out = _MRel(self.alias, {}, rows)
+        out.cols = {**self.cols, **other.cols}
+        out.widths = {**self.widths, **other.widths}
+        out.dom = {**self.dom, **other.dom}
+        out.rows = int(rows)
+        return out
+
+
+class _MemCost:
+    """Accumulator for one statement walk: running peak-byte sum (a
+    conservative everything-live-at-once over-approximation) plus the
+    streamed-scan bounds discovered along the way."""
+
+    def __init__(self):
+        self.peak = 0
+        self.scans: list = []
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+
+class MemAuditor:
+    """Host-only abstract interpreter computing peak-HBM byte bounds.
+
+    ``streamed`` names the tables bound as >HBM ChunkedTables (the same
+    binding model `exec_audit` uses); ``model`` carries capacities and
+    cardinalities. The walk mirrors ``Planner._flatten_from`` →
+    ``_join_parts`` → downstream aggregation, tracking (row bound,
+    per-column width, per-column domain) per relation."""
+
+    DEFAULT_STREAMED = ("catalog_sales", "inventory", "store_sales",
+                        "web_sales")
+
+    def __init__(self, streamed=None, model: MemModel | None = None,
+                 base_tables=None):
+        self.model = model or MemModel()
+        self.streamed = set(self.DEFAULT_STREAMED if streamed is None
+                            else streamed)
+        self.base_tables = set(self.model.widths if base_tables is None
+                               else base_tables)
+        self.needed: set | None = None
+
+    # -- entry point --------------------------------------------------------
+
+    def audit_sql(self, sql: str, file: str = "<sql>",
+                  query: str = "<sql>") -> MemReport:
+        try:
+            stmt = parse(sql)
+        except ParseError as e:
+            return MemReport(file, query, "unknown", detail=str(e))
+        self.needed = statement_needed_names(stmt)
+        cost = _MemCost()
+        env = self._base_env()
+        out_rows = 0
+        try:
+            if isinstance(stmt, A.Query):
+                out_rows = self._audit_query(stmt, env, cost).rows
+            elif isinstance(stmt, (A.InsertInto, A.CreateTempView)):
+                out_rows = self._audit_query(stmt.query, env, cost).rows
+            elif isinstance(stmt, A.DeleteFrom):
+                name = stmt.table.lower()
+                rows = self.model.table_rows(name) or 1
+                cost.peak += rows * self.model.pruned_width(name, None)
+            else:
+                return MemReport(file, query, "unknown",
+                                 detail=f"unmodeled statement "
+                                        f"{type(stmt).__name__}")
+        except RecursionError:
+            return MemReport(file, query, "unknown",
+                             detail="recursion limit")
+        mode = "streamed" if cost.scans else "device"
+        return MemReport(file, query, mode, peak_bytes=cost.peak,
+                         out_rows=out_rows, scans=tuple(cost.scans))
+
+    def _base_env(self) -> dict:
+        env = {}
+        for name, widths in self.model.widths.items():
+            rows = self.model.table_rows(name) or 1
+            env[name] = (widths, rows, name in self.base_tables)
+        return env
+
+    # -- query / set-expression walk ---------------------------------------
+
+    def _audit_query(self, q: A.Query, env: dict, cost: _MemCost) -> _MRel:
+        env = dict(env)
+        for cname, cq in q.ctes:
+            out = self._audit_query(cq, env, cost)
+            widths = {c: w for cols in out.widths.values()
+                      for c, w in cols.items()}
+            # a CTE result is a device table whatever it scanned; it may
+            # shadow a chunked catalog name (the planner resolves CTEs
+            # first, so the statement does not stream the shadowed table)
+            env[cname.lower()] = (widths, out.rows, False)
+        out = self._audit_body(q.body, env, cost)
+        # ORDER BY: the device lexsort holds one index vector alongside
+        # the input — 8 B per row, already dominated by the conservative
+        # sum; LIMIT clamps the output rows exactly
+        if q.limit is not None:
+            out.rows = min(out.rows, max(int(q.limit), 0))
+        return out
+
+    def _audit_body(self, body, env: dict, cost: _MemCost) -> _MRel:
+        if isinstance(body, A.SetOp):
+            left = self._audit_body(body.left, env, cost)
+            right = self._audit_body(body.right, env, cost)
+            rows = left.rows + right.rows
+            # the concatenated buffer is a fresh allocation alongside the
+            # branches (UNION's distinct grouping reuses it in place)
+            cost.peak += _bucket(max(rows, 1)) * max(left.width,
+                                                     right.width, 1)
+            if body.op in ("intersect", "except"):
+                rows = left.rows         # both are subsets of the left
+            elif body.op == "union":
+                # distinct union: also bounded by the output columns'
+                # value-domain product (same rule as SELECT DISTINCT)
+                doms = [d for cols in left.dom.values()
+                        for d in cols.values()]
+                if doms:
+                    dom = 1
+                    for v in doms:
+                        dom = min(dom * max(v, 1), max(rows, 1))
+                    rows = min(rows, max(dom, 1))
+            out = _MRel(left.alias, {}, rows)
+            out.cols, out.widths, out.dom = left.cols, left.widths, left.dom
+            out.rows = rows
+            return out
+        if isinstance(body, A.Query):
+            return self._audit_query(body, env, cost)
+        return self._audit_select(body, env, cost)
+
+    # -- SELECT -------------------------------------------------------------
+
+    def _audit_select(self, sel: A.Select, env: dict,
+                      cost: _MemCost) -> _MRel:
+        where = _conjuncts_of(sel.where)
+        parts, preds = self._flatten_from(sel.from_, env, cost)
+        if parts:
+            joined = self._audit_graph(parts, list(preds) + list(where),
+                                       env, cost)
+        else:
+            joined = _MRel("_dual", {}, 1, single_row=True)
+        for item in sel.items:
+            self._walk_subqueries(item.expr, env, cost)
+        if sel.having is not None:
+            self._walk_subqueries(sel.having, env, cost)
+        if not parts:
+            # with a FROM graph the WHERE conjuncts were handed to
+            # _audit_graph, which walks their subqueries exactly once
+            for c in where:
+                self._walk_subqueries(c, env, cost)
+
+        rows = joined.rows
+        if sel.group_by is not None:
+            gb = sel.group_by
+            # group output <= product of the key value domains (a base
+            # column's domain is at most its table's rows), clamped at
+            # input rows; grouping sets replay the aggregation per set
+            dom = 1
+            for e in gb.exprs:
+                d = joined.col_domain(e) if isinstance(e, A.ColumnRef) \
+                    else rows
+                dom = min(dom * max(d, 1), max(rows, 1))
+            n_sets = max(len(gb.sets), 1) if gb.kind != "plain" else 1
+            rows = min(rows, max(dom, 1)) * n_sets
+        elif self._has_aggregate_items(sel):
+            rows = 1                       # keyless aggregate: one row
+
+    # -- projection: output widths/domains ----------------------------------
+
+        widths, dom = {}, {}
+        for i, item in enumerate(sel.items):
+            e = item.expr
+            if isinstance(e, A.Star):
+                qual = e.table and e.table.lower()
+                for a, cols in joined.widths.items():
+                    if qual is None or a == qual:
+                        widths.update(cols)
+                        dom.update(joined.dom.get(a, {}))
+                continue
+            if item.alias:
+                name = item.alias.lower()
+            elif isinstance(e, A.ColumnRef):
+                name = e.name.lower()
+            else:
+                name = f"_c{i}"
+            if isinstance(e, A.ColumnRef):
+                widths[name] = joined.col_width(e)
+                dom[name] = joined.col_domain(e)
+            else:
+                widths[name] = 9
+                dom[name] = rows
+        out = _MRel("_out", widths, rows, dom=dom)
+        if sel.distinct and dom:
+            d = 1
+            for v in dom.values():
+                d = min(d * max(v, 1), max(rows, 1))
+            out.rows = rows = min(rows, max(d, 1))
+        # the projected output is a fresh materialization
+        cost.peak += _bucket(max(rows, 1)) * max(out.width, 1)
+        return out
+
+    def _has_aggregate_items(self, sel: A.Select) -> bool:
+        from nds_tpu.sql.parser import AGG_FUNCS
+
+        def has_agg(e) -> bool:
+            if isinstance(e, A.FuncCall) and e.name.lower() in AGG_FUNCS:
+                return True
+            return any(has_agg(c) for c in _children(e))
+
+        return any(has_agg(i.expr) for i in sel.items
+                   if not isinstance(i.expr, A.Star))
+
+    # -- FROM flattening (mirror of Planner._flatten_from) ------------------
+
+    def _flatten_from(self, node, env: dict, cost: _MemCost):
+        if node is None:
+            return [], []
+        if isinstance(node, A.TableRef):
+            name = node.name.lower()
+            alias = (node.alias or node.name).lower()
+            widths, rows, is_base = env.get(name, ({}, 1, False))
+            widths = self._prune(widths)
+            chunked = is_base and name in self.streamed
+            rel = _MRel(alias, widths, rows,
+                        source=name if is_base else None, chunked=chunked)
+            if is_base and not chunked:
+                # a device-resident base scan uploads its pruned columns
+                cost.peak += _bucket(rows) * rel.width
+            return [rel], []
+        if isinstance(node, A.SubqueryRef):
+            out = self._audit_query(node.query, env, cost)
+            rel = _MRel(node.alias,
+                        {c: w for cols in out.widths.values()
+                         for c, w in cols.items()}, out.rows,
+                        single_row=_single_row_query(node.query))
+            return [rel], []
+        if isinstance(node, A.Join):
+            if node.kind in ("cross", "inner"):
+                lp, lj = self._flatten_from(node.left, env, cost)
+                rp, rj = self._flatten_from(node.right, env, cost)
+                return lp + rp, lj + rj + _conjuncts_of(node.condition)
+            # outer/semi/anti join: each side materializes whole first
+            lp, lj = self._flatten_from(node.left, env, cost)
+            left = self._audit_graph(lp, lj, env, cost)
+            rp, rj = self._flatten_from(node.right, env, cost)
+            right = self._audit_graph(rp, rj, env, cost)
+            rows = self._binary_join_rows(node, left, right)
+            merged = left.merged_with(right, rows)
+            cost.peak += _bucket(max(rows, 1)) * merged.width
+            return [merged], []
+        if isinstance(node, A.Query):        # parenthesized join tree
+            return self._flatten_from(getattr(node.body, "from_", None),
+                                      env, cost)
+        return [], []
+
+    def _prune(self, widths: dict) -> dict:
+        if self.needed is None:
+            return dict(widths)
+        kept = {c: w for c, w in widths.items() if c in self.needed}
+        return kept if kept and len(kept) < len(widths) else dict(widths)
+
+    def _binary_join_rows(self, node: A.Join, left: _MRel,
+                          right: _MRel) -> int:
+        """Row bound of one materialized (outer/semi/anti) binary join.
+        Semi/anti never grow the left side; a LEFT join against a side
+        whose ON keys cover its declared primary key is 1:1 (matches +
+        extras <= left rows); everything else is bounded by the pair
+        bucket plus the null-extended extras."""
+        if node.kind in ("semi", "anti"):
+            return left.rows
+        conjuncts = _conjuncts_of(node.condition)
+        part_cols = [left.colset(), right.colset()]
+        sources = [left.source, right.source]
+        unique = {}
+        for side, other in ((1, 0), (0, 1)):
+            pk = _table_pk(sources[side])
+            keys = set()
+            for c in conjuncts:
+                e = _equi_sides(c, part_cols)
+                if e is None:
+                    continue
+                li, ri, lk, rk = e
+                k = lk if li == side else (rk if ri == side else None)
+                if k is not None:
+                    keys.add(k)
+            unique[side] = pk is not None and keys >= set(pk)
+        pairs = left.rows if unique.get(1) else (
+            right.rows if unique.get(0) and node.kind != "left"
+            else _bucket(max(left.rows, 1)) * self.model.fanout)
+        if node.kind == "left":
+            return pairs + left.rows
+        if node.kind == "right":
+            return pairs + right.rows
+        if node.kind == "full":
+            return pairs + left.rows + right.rows
+        return pairs
+
+    # -- join-graph bounds (mirror of Planner._join_parts) ------------------
+
+    def _audit_graph(self, parts, conjuncts, env, cost: _MemCost) -> _MRel:
+        if not parts:
+            return _MRel("_dual", {}, 1, single_row=True)
+        if len(parts) == 1 and not any(p.chunked for p in parts):
+            for c in conjuncts:
+                self._walk_subqueries(c, env, cost)
+            return parts[0]
+        part_cols = [p.colset() for p in parts]
+        sources = [p.source for p in parts]
+        batches: dict = {}
+        unprovable = False
+        for c in conjuncts:
+            if _has_subquery(c):
+                self._walk_subqueries(c, env, cost)
+                unprovable = True
+                continue
+            e = _equi_sides(c, part_cols)
+            if e is not None:
+                li, ri, _lk, _rk = e
+                batches.setdefault(tuple(sorted((li, ri))), []).append(e)
+
+        parent = list(range(len(parts)))
+
+        def find(x):
+            while parent[x] != x:
+                parent[x] = parent[parent[x]]
+                x = parent[x]
+            return x
+
+        for (a, b) in batches:
+            parent[find(a)] = find(b)
+
+        # per-component row bound: the largest member, times the enforced
+        # fanout bucket for every batch with no unique side; components
+        # multiply (cartesian layout is an exact product)
+        comp_rows: dict = {}
+        for i, p in enumerate(parts):
+            r = find(i)
+            base = 1 if p.single_row else max(p.rows, 1)
+            comp_rows[r] = max(comp_rows.get(r, 1), base)
+        chunked_idx = [i for i, p in enumerate(parts) if p.chunked]
+        keep = max(chunked_idx, key=lambda i: parts[i].rows *
+                   max(parts[i].width, 1)) if chunked_idx else None
+        for (a, b), batch in batches.items():
+            if not _batch_unique_side(part_cols, sources,
+                                      keep if keep is not None else -1,
+                                      a, b, batch):
+                r = find(a)
+                comp_rows[r] = _bucket(comp_rows[r]) * self.model.fanout
+        joined_rows = 1
+        for r in comp_rows.values():
+            joined_rows *= r
+
+        merged = parts[0]
+        for p in parts[1:]:
+            merged = merged.merged_with(p, joined_rows)
+        merged.rows = joined_rows
+
+        if keep is None:
+            # device-resident graph: the joined result materializes whole
+            cost.peak += _bucket(max(joined_rows, 1)) * merged.width
+            return merged
+
+        # streamed graph: non-kept chunked parts bind whole (one
+        # streaming axis per graph) — charge their resident bytes
+        for i in chunked_idx:
+            if i != keep:
+                cost.peak += _bucket(parts[i].rows) * parts[i].width
+        kept = parts[keep]
+        k = None if unprovable else stream_graph_fanout(
+            part_cols, sources, keep, conjuncts)
+        chunk_bytes = self.model.chunk_cap() * kept.width
+        if k is not None:
+            acc_rows = self.model.acc_row_bound(kept.rows, k)
+            if self.model.acc_ceiling is not None:
+                acc_rows = min(acc_rows, self.model.acc_ceiling)
+            acc_bytes = acc_rows * merged.width
+            survivors = min(joined_rows, acc_rows)
+        else:
+            # eager loop: survivors concatenate up to the graph bound
+            acc_rows = acc_bytes = None
+            survivors = joined_rows
+        sb = ScanBound(kept.alias, kept.source or "?", kept.rows, k,
+                       acc_rows, acc_bytes, chunk_bytes)
+        cost.scans.append(sb)
+        # working set: two chunks in flight + the survivor accumulator
+        # (or, eager, the concatenated survivor union)
+        cost.peak += 2 * chunk_bytes + (
+            acc_bytes if acc_bytes is not None
+            else _bucket(max(survivors, 1)) * merged.width)
+        merged.rows = survivors
+        return merged
+
+    # -- subqueries inside expressions --------------------------------------
+
+    def _walk_subqueries(self, e, env: dict, cost: _MemCost) -> None:
+        def walk(node):
+            if isinstance(node, (A.InSubquery, A.ScalarSubquery, A.Exists,
+                                 A.QuantifiedCompare)):
+                self._audit_query(node.query, env, cost)
+                return
+            for c in _children(node):
+                walk(c)
+
+        walk(e)
+
+
+# ---------------------------------------------------------------------------
+# corpus driver + lint-gate findings
+# ---------------------------------------------------------------------------
+
+# pinned instantiation seed shared with plan_audit/exec_audit: bounds must
+# not depend on sampled parameter values
+_AUDIT_SEED = 20260803
+
+
+def audit_mem_template_text(text: str, file: str,
+                            auditor: MemAuditor | None = None) -> list:
+    auditor = auditor or MemAuditor()
+    sql = instantiate_template(text, np.random.default_rng(_AUDIT_SEED))
+    stmts = [s for s in sql.split(";") if s.strip()]
+    base = os.path.basename(file)
+    out = []
+    for i, stmt in enumerate(stmts):
+        qname = base[:-4] if base.endswith(".tpl") else base
+        if len(stmts) > 1:
+            qname = f"{qname}_part{i + 1}"
+        out.append(auditor.audit_sql(stmt, file=base, query=qname))
+    return out
+
+
+def audit_mem_corpus(template_dir: str | None = None, streamed=None,
+                     model: MemModel | None = None) -> list:
+    """MemReports for every template in templates.lst order."""
+    template_dir = template_dir or TEMPLATE_DIR
+    auditor = MemAuditor(streamed=streamed, model=model)
+    reports: list = []
+    for name in list_templates(template_dir):
+        reports.extend(audit_mem_template_text(
+            load_template(name, template_dir), name, auditor))
+    return reports
+
+
+def reports_to_findings(reports, capacity_bytes: int | None = None) -> list:
+    """``hbm-capacity`` findings: a device-resident statement whose peak
+    bound exceeds the configured capacity cannot be admitted at the
+    audited scale, and a streamed statement whose proven accumulator
+    bound exceeds it would be sized past HBM (the runtime would fall back
+    to the legacy ceiling and risk the overflow rerun the proof exists to
+    retire). Eager-fallback scans (unprovable multiplicity) are reported
+    in ``--mem-report`` but not gated — the eager loop's working set is
+    per-chunk."""
+    cap = hbm_capacity_bytes() if capacity_bytes is None else capacity_bytes
+    findings = []
+    for r in reports:
+        if r.mode == "device" and r.peak_bytes > cap:
+            findings.append(Finding(
+                r.file, r.query, "hbm-capacity", "error",
+                f"device-resident peak bound {r.peak_bytes:,} B exceeds "
+                f"the configured HBM capacity {cap:,} B "
+                "(NDS_TPU_HBM_BYTES)"))
+        for s in r.scans:
+            if s.provable and s.acc_bytes is not None and s.acc_bytes > cap:
+                findings.append(Finding(
+                    r.file, r.query, "hbm-capacity", "error",
+                    f"streamed scan {s.table!r} accumulator bound "
+                    f"{s.acc_bytes:,} B ({s.acc_rows:,} rows) exceeds the "
+                    f"configured HBM capacity {cap:,} B"))
+    return findings
+
+
+def mem_audit_findings(template_dir: str | None = None) -> list:
+    """The lint pass entry point (tools/lint.py fifth pass)."""
+    return reports_to_findings(audit_mem_corpus(template_dir))
+
+
+def _human(n) -> str:
+    if n is None:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return str(n)
+
+
+def format_mem_report(reports) -> str:
+    """The per-statement bound table (``tools/lint.py --mem-report``)."""
+    cap = hbm_capacity_bytes()
+    lines = ["# mem-audit: per-statement peak-HBM byte bounds",
+             f"# capacity model: {_human(cap)} (NDS_TPU_HBM_BYTES)",
+             f"{'template':<18} {'mode':<9} {'peak':>9}  accumulators"]
+    worst = 0
+    for r in reports:
+        worst = max(worst, r.peak_bytes)
+        bits = []
+        for s in r.scans:
+            if s.provable:
+                bits.append(f"{s.table}: {_human(s.acc_bytes)} "
+                            f"({s.acc_rows:,} rows, k={s.fanout_k})")
+            else:
+                bits.append(f"{s.table}: unprovable (eager loop)")
+        lines.append(f"{r.query:<18} {r.mode:<9} "
+                     f"{_human(r.peak_bytes):>9}  " + "; ".join(bits))
+    lines.append(f"# {len(reports)} statements — worst peak bound "
+                 f"{_human(worst)} vs capacity {_human(cap)}")
+    return "\n".join(lines)

@@ -1347,7 +1347,7 @@ def join_indices(left_keys, right_keys, how: str = "inner",
             # so the excess registers as a device-side overflow flag the
             # streaming executor checks at its single materializing sync.
             total_dev = jnp.sum(counts)
-            cand = min(bucket_len(count_bound(n_left)) * _STREAM_FANOUT,
+            cand = min(bucket_len(count_bound(n_left)) * stream_fanout(),
                        bucket_len(_PAIR_BUDGET))
             stream_overflow(total_dev > cand)
             pair_live = jnp.arange(cand) < total_dev
@@ -1698,9 +1698,12 @@ _PAIR_BUDGET = int(os.environ.get("NDS_TPU_PAIR_BUDGET", str(1 << 22)))
 # stream-bounds pair-bucket fanout: inside the compiled chunk pipeline a
 # hash join cannot sync for its candidate total, so the bucket is the
 # probe side's bound times this power-of-two allowance (kept power-of-two
-# so bucket shapes stay canonical); overflow falls back to the eager loop
-_STREAM_FANOUT = _pow2_ceil(int(os.environ.get("NDS_TPU_STREAM_FANOUT",
-                                               "4")))
+# so bucket shapes stay canonical); overflow falls back to the eager loop.
+# Read at USE time (not import): tests and Throughput children that set
+# NDS_TPU_STREAM_FANOUT after import must not be silently ignored. The
+# static memory model (analysis/mem_audit.py) mirrors this read.
+def stream_fanout() -> int:
+    return _pow2_ceil(int(os.environ.get("NDS_TPU_STREAM_FANOUT", "4")))
 
 
 @functools.partial(jax.jit, static_argnames=("cand",))

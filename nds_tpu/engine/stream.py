@@ -36,10 +36,17 @@ The eager loop remains reachable as ``NDS_TPU_STREAM_EXEC=eager`` (escape
 hatch) and as the automatic fallback for graphs that are not
 chunk-invariant (outer-join extras, cartesians, subquery residuals).
 
-Env knobs: ``NDS_TPU_STREAM_EXEC`` (compiled|eager),
-``NDS_TPU_STREAM_ACC_ROWS`` (survivor accumulator row ceiling, default
-2^23), ``NDS_TPU_STREAM_FANOUT`` (ops.py: stream-mode join pair-bucket
-allowance, default 4).
+Survivor accumulators are sized from the statement's PROVEN row bound
+(the static memory model of ``nds_tpu/analysis/mem_audit.py``: schema PK
+uniqueness + stream-fanout pair buckets), so a statement whose bound fits
+the ``NDS_TPU_HBM_BYTES`` capacity model can never trip the overflow
+rerun; unprovable or over-capacity bounds fall back to the legacy 2^23
+guess. Env knobs (all read at pipeline-BUILD time, never frozen at
+import): ``NDS_TPU_STREAM_EXEC`` (compiled|eager),
+``NDS_TPU_STREAM_ACC_ROWS`` (explicit hard accumulator ceiling / escape
+hatch; unset = proof-sized), ``NDS_TPU_STREAM_FANOUT`` (ops.py:
+stream-mode join pair-bucket allowance, default 4),
+``NDS_TPU_HBM_BYTES`` (capacity model, default 16 GiB).
 """
 
 from __future__ import annotations
@@ -60,11 +67,68 @@ from nds_tpu.obs import trace as _obs
 
 log = logging.getLogger(__name__)
 
-# survivor-accumulator row ceiling: the device-resident budget for rows the
-# pipeline may keep across ALL chunks. Exceeding it sets the overflow flag
-# and the query re-runs eagerly — the knob trades HBM headroom against
-# streamed coverage.
-_ACC_ROWS = int(os.environ.get("NDS_TPU_STREAM_ACC_ROWS", str(1 << 23)))
+# legacy survivor-accumulator row guess: the clamp applied only when the
+# static memory proof cannot admit a bound (unprovable multiplicity, or a
+# proven bound past the HBM capacity model). Provable statements size
+# their accumulator from the proof instead (see _acc_row_budget), so a
+# statement whose bound fits can never trip the overflow rerun.
+_DEFAULT_ACC_ROWS = 1 << 23
+
+
+def _acc_ceiling() -> int | None:
+    """NDS_TPU_STREAM_ACC_ROWS: the explicit hard ceiling / escape hatch.
+    Read at pipeline-BUILD time (not import) so tests and Throughput
+    children that set it after import are honored."""
+    env = os.environ.get("NDS_TPU_STREAM_ACC_ROWS")
+    return int(env) if env else None
+
+
+def _proved_row_bound(parts, keep, join_preds, where_conjuncts, sources,
+                      nrows):
+    """Statement-level survivor-row bound of the streamed graph, proven by
+    the static memory model (analysis/mem_audit.py): bucket(rows) x
+    fanout^k where k counts the join batches with no PK-unique side. None
+    when unprovable (subquery conjunct / unconnected graph — the trace
+    diverges there and the eager loop serves the query anyway)."""
+    try:
+        from nds_tpu.analysis.mem_audit import (stream_graph_fanout,
+                                                structural_row_bound)
+        part_cols = [{str(c).lower() for c in p.column_names}
+                     for p in parts]
+        srcs = [s.lower() if isinstance(s, str) else None for s in sources]
+        k = stream_graph_fanout(part_cols, srcs, keep,
+                                list(join_preds) + list(where_conjuncts))
+        if k is None:
+            return None
+        return structural_row_bound(int(nrows), k, E.stream_fanout())
+    except Exception:                    # never let the proof break a query
+        return None
+
+
+def _acc_row_budget(n_chunks, chunk_out_plen, proved, row_bytes):
+    """Rows the survivor accumulator is sized for. Always bounded by the
+    per-chunk-bucket sum (each chunk contributes at most its output
+    bucket); the proof tightens it. The env ceiling, when set, stays a
+    hard clamp (overflow then reruns eagerly — correctness never depends
+    on the proof); without one, a bound the capacity model cannot admit
+    falls back to the legacy guess."""
+    rows = n_chunks * chunk_out_plen
+    if proved is not None:
+        rows = min(rows, proved)
+    ceiling = _acc_ceiling()
+    if ceiling is not None:
+        return min(rows, ceiling)
+    if proved is None or rows * row_bytes > _hbm_bytes():
+        return min(rows, _DEFAULT_ACC_ROWS)
+    return rows
+
+
+def _hbm_bytes() -> int:
+    try:
+        from nds_tpu.analysis.mem_audit import hbm_capacity_bytes
+        return hbm_capacity_bytes()
+    except Exception:
+        return 16 << 30
 
 # compiled pipelines are cached across statements (a Power Run executes
 # each query text 2-4 times); bounded FIFO, identity-validated on hit.
@@ -317,6 +381,10 @@ def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
         tuple(((tuple((cn, ck, hv) for (cn, ck, _dv, hv) in spec[0]),
                 spec[1], spec[2]))
               for (spec, _flat) in part_infos),
+        # accumulator-sizing knobs: a pipeline built under a different
+        # ceiling/capacity/fanout must not be reused (its compiled acc
+        # shapes bake the old budget in)
+        _acc_ceiling(), _hbm_bytes(), E.stream_fanout(),
     )
 
 
@@ -432,7 +500,8 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
         log.info("streamed pipeline overflowed its bound buckets; "
                  "re-running %s eagerly", alias)
         return None, "bound-bucket overflow"
-    record_stream_event(alias, ran, E.sync_count() - syncs0, "compiled")
+    record_stream_event(alias, ran, E.sync_count() - syncs0, "compiled",
+                        rows=int(out.nrows))
     _obs.annotate(path="compiled", chunks=ran)
     return out, None
 
@@ -477,8 +546,18 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
                 [out0[n].dict_values for n in names],
                 [out0[n].valid is not None for n in names],
                 [out0[n].data.dtype for n in names])
-    acc_cap = E.bucket_len(
-        max(min(n_chunks * out0.plen, _ACC_ROWS), out0.plen))
+    # size the survivor accumulator from the statement's proven row bound
+    # (static memory model) instead of the old global guess: a statement
+    # whose bound fits the capacity model can never overflow-rerun
+    row_bytes = sum(out0[n].data.dtype.itemsize
+                    + (1 if out0[n].valid is not None else 0)
+                    for n in names)
+    proved = _proved_row_bound(parts, keep, join_preds, where_conjuncts,
+                               masked_sources, parts[keep].chunked.nrows)
+    budget = _acc_row_budget(n_chunks, out0.plen, proved, max(row_bytes, 1))
+    acc_cap = E.bucket_len(max(budget, out0.plen))
+    _obs.annotate(accRows=acc_cap,
+                  provedRows=proved if proved is not None else "unproven")
     lifted, operands = _lift_log(list(rec_log))
     pipe = StreamPipeline(
         chunk_spec, chunk_cap,

@@ -112,13 +112,17 @@ class StreamEvent:
     syncs: int                 # host syncs charged while the scan executed
     path: str                  # "compiled" | "eager"
     reason: str = ""           # why the compiled path was not taken
+    rows: int = -1             # survivor rows the scan kept (compiled
+    #                            pipeline: the accumulator's final count —
+    #                            the number tools/mem_audit_diff.py checks
+    #                            against the static bound; -1 = unknown)
 
 
 _stream_tls = threading.local()
 
 
 def record_stream_event(where: str, chunks: int, syncs: int, path: str,
-                        reason: str = "") -> None:
+                        reason: str = "", rows: int = -1) -> None:
     """Engine-side hook (engine/stream.py, sql/planner.py): record how a
     streamed scan executed. Thread-scoped like the sync counters, so
     concurrent Throughput streams account their own pipelines."""
@@ -126,7 +130,7 @@ def record_stream_event(where: str, chunks: int, syncs: int, path: str,
     if lst is None:
         # deque(maxlen): diagnostics ring, never unbounded, O(1) evict
         lst = _stream_tls.events = deque(maxlen=1000)
-    lst.append(StreamEvent(where, chunks, syncs, path, reason))
+    lst.append(StreamEvent(where, chunks, syncs, path, reason, rows))
 
 
 def drain_stream_events() -> list:

@@ -254,6 +254,7 @@ def run_query_stream(input_prefix: str,
             q_report.summary["streamedScans"] = [
                 {"table": e.where, "chunks": e.chunks, "syncs": e.syncs,
                  "path": e.path,
+                 **({"rows": e.rows} if e.rows >= 0 else {}),
                  **({"reason": e.reason} if e.reason else {})}
                 for e in stream_events]
         # per-phase trace rollup (nds_tpu/obs): where the query's wall

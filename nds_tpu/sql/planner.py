@@ -1055,7 +1055,13 @@ class Planner:
                 reason = "NDS_TPU_STREAM_EXEC=eager"
             outs = []
             n_chunks = 0
-            with _obs.span("stream.eager",
+            # a bound-bucket overflow discards a COMPLETED compiled run:
+            # the rerun gets its own span name so tools/trace_report.py
+            # can price the wasted pipeline work separately from ordinary
+            # eager fallbacks (which never drove the pipeline at all)
+            eager_span = "stream.overflow-rerun" \
+                if reason == "bound-bucket overflow" else "stream.eager"
+            with _obs.span(eager_span,
                            reason=reason or "replay-nested"):
                 for chunk in parts[keep].device_chunks(self):
                     n_chunks += 1

@@ -728,12 +728,14 @@ def exec_audit(sql, streamed=("store_sales",)):
 
 
 def test_exec_audit_ab_templates_classification():
-    """The 4 A/B templates pinned by test_synccount: the static auditor
-    must predict the exact path the runtime takes — 3 compiled-stream
-    (the chunk pipeline) and the IN-subquery template eager-fallback with
-    the subquery-residual reason (its residual needs the catalog, which
-    the chunk-invariant program must not close over) — with every
-    compiled scan's steady-state bound inside the streamed budget."""
+    """The A/B templates pinned by test_synccount: the static auditor
+    must predict the exact path the runtime takes — compiled-stream for
+    the chunk-pipeline shapes (including the three bare scans the memory
+    proof reclassified from `accumulator-overflow`) and eager-fallback
+    with the subquery-residual reason for the IN-subquery template (its
+    residual needs the catalog, which the chunk-invariant program must
+    not close over) — with every compiled scan's steady-state bound
+    inside the streamed budget."""
     from nds_tpu.analysis.exec_audit import (CLASS_COMPILED, CLASS_EAGER,
                                              SYNC_BUDGET)
     from test_synccount import _STREAM_AB_QUERIES
@@ -773,8 +775,25 @@ def test_exec_audit_reason_codes():
     assert r.classification == "eager-fallback"
     assert r.reasons == ("chunk-dependent-host-read",)
     assert r.sync_bound is None and r.per_chunk >= 1
-    # bare scan: the survivor accumulator keeps every chunk row
+    # bare scan: the survivor accumulator keeps every chunk row — but the
+    # memory proof admits it (pruned SF10 store_sales fits the capacity
+    # model), so it streams compiled with the proof-sized accumulator
     r = exec_audit("select ss_item_sk from store_sales")
+    assert r.classification == "compiled-stream" and not r.reasons
+    # ...and the SAME bare scan against a capacity model that cannot
+    # admit the bound keeps the accumulator-overflow fallback (lockstep
+    # with the runtime's legacy-ceiling clamp + overflow rerun)
+    from nds_tpu.analysis.exec_audit import ExecAuditor
+    from nds_tpu.analysis.mem_audit import MemModel
+    tiny = ExecAuditor(streamed={"store_sales"},
+                       mem_model=MemModel(capacity_bytes=1 << 20))
+    r = tiny.audit_sql("select ss_item_sk from store_sales")
+    assert r.reasons == ("accumulator-overflow",)
+    # an explicit NDS_TPU_STREAM_ACC_ROWS ceiling below the table's rows
+    # also forbids the proof (the hard ceiling wins; overflow certain)
+    capped = ExecAuditor(streamed={"store_sales"},
+                         mem_model=MemModel(acc_ceiling=1 << 10))
+    r = capped.audit_sql("select ss_item_sk from store_sales")
     assert r.reasons == ("accumulator-overflow",)
     # bare scan on an outer-join side: extras semantics materialize the
     # whole side
@@ -865,6 +884,169 @@ def test_exec_audit_differential_harness():
 
 
 # ---------------------------------------------------------------------------
+# mem audit: static peak-HBM bounds + accumulator proofs
+# ---------------------------------------------------------------------------
+
+
+def mem_audit(sql, streamed=("store_sales",), **model_kw):
+    from nds_tpu.analysis.mem_audit import MemAuditor, MemModel
+    return MemAuditor(streamed=set(streamed),
+                      model=MemModel(**model_kw)).audit_sql(sql)
+
+
+def test_mem_audit_corpus_finite_and_deterministic():
+    """Every template statement gets a finite positive byte bound, the
+    walk is deterministic, and the only capacity findings are the 7
+    baselined fan-out accumulators (query17/24x2/25/29/64/72)."""
+    from nds_tpu.analysis.mem_audit import (audit_mem_corpus,
+                                            reports_to_findings)
+    reports = audit_mem_corpus()
+    assert len(reports) >= 99
+    for r in reports:
+        assert r.mode in ("streamed", "device"), (r.query, r.detail)
+        assert r.peak_bytes > 0 and r.out_rows >= 0
+    fs = reports_to_findings(reports)
+    assert all(f.rule == "hbm-capacity" and f.severity == "error"
+               for f in fs)
+    assert sorted({f.file for f in fs}) == \
+        ["query17.tpl", "query24.tpl", "query25.tpl", "query29.tpl",
+         "query64.tpl", "query72.tpl"]
+    again = audit_mem_corpus()
+    assert [r.to_dict() for r in again] == [r.to_dict() for r in reports]
+
+
+def test_mem_audit_bound_rules():
+    """The bound rules of DESIGN.md's static memory model, each on its
+    canonical shape."""
+    # PK star join: every batch covers a dimension primary key, so the
+    # survivor multiplicity is 1 (k=0) and the accumulator is bounded by
+    # the fact side's bucketed rows
+    r = mem_audit("""
+        select d_year, sum(ss_ext_sales_price) s
+        from store_sales, date_dim, item
+        where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+        group by d_year""")
+    (s,) = r.scans
+    assert s.provable and s.fanout_k == 0
+    # group-by domain rule: d_year's value domain is at most date_dim's
+    # row bound, far below the fact's
+    from nds_tpu.analysis.mem_audit import DEFAULT_ROW_BOUNDS
+    assert r.out_rows <= DEFAULT_ROW_BOUNDS["date_dim"]
+    # non-PK equi join: bounded only by the enforced fanout pair bucket
+    r = mem_audit("""
+        select count(*) c from store_sales, item
+        where ss_item_sk = i_brand_id""")
+    (s,) = r.scans
+    assert s.provable and s.fanout_k == 1
+    assert r.out_rows == 1               # keyless aggregate: one row
+    # a subquery conjunct makes the multiplicity unprovable (the runtime
+    # trace diverges there: eager loop)
+    r = mem_audit("""
+        select count(*) c from store_sales where ss_sold_date_sk in
+        (select d_date_sk from date_dim where d_moy = 11)""")
+    assert r.scans and not r.scans[0].provable
+    # unconnected parts (cartesian layout): unprovable too
+    r = mem_audit("select count(*) c from store_sales, item "
+                  "where ss_ext_sales_price > 0 and i_brand_id = 1")
+    assert r.scans and not r.scans[0].provable
+    # filters assume no reduction: the filtered bare scan keeps the same
+    # accumulator bound as the unfiltered one
+    a = mem_audit("select ss_item_sk from store_sales")
+    b = mem_audit("select ss_item_sk from store_sales "
+                  "where ss_item_sk > 10")
+    assert a.scans[0].acc_rows == b.scans[0].acc_rows
+    # column pruning: referencing fewer columns shrinks the byte bound
+    wide = mem_audit("select ss_item_sk, ss_ext_sales_price, "
+                     "ss_sold_date_sk from store_sales")
+    assert a.scans[0].acc_bytes < wide.scans[0].acc_bytes
+    # LIMIT clamps the output-row bound exactly
+    r = mem_audit("select ss_item_sk from store_sales "
+                  "order by ss_item_sk limit 7")
+    assert r.out_rows == 7
+    # intersect/except output is a subset of the LEFT branch, never the
+    # branch sum
+    r = mem_audit("select d_year from date_dim except "
+                  "select d_year from date_dim where d_moy = 1",
+                  streamed=())
+    assert r.out_rows == DEFAULT_ROW_BOUNDS["date_dim"]
+
+
+def test_mem_audit_capacity_gate():
+    """hbm-capacity trips when a proven accumulator bound (streamed) or a
+    device-resident peak bound exceeds the configured capacity."""
+    from nds_tpu.analysis.mem_audit import reports_to_findings
+    r = mem_audit("select ss_item_sk from store_sales",
+                  capacity_bytes=1 << 20)
+    fs = reports_to_findings([r], capacity_bytes=1 << 20)
+    assert [f.rule for f in fs] == ["hbm-capacity"]
+    assert "accumulator" in fs[0].message
+    # same statement under the default model: clean
+    assert not reports_to_findings([mem_audit(
+        "select ss_item_sk from store_sales")])
+    # device-resident peak gate
+    r = mem_audit("select * from customer", streamed=())
+    assert r.mode == "device"
+    fs = reports_to_findings([r], capacity_bytes=1 << 10)
+    assert [f.rule for f in fs] == ["hbm-capacity"]
+    assert "device-resident" in fs[0].message
+
+
+def test_mem_audit_scoped_star_pruning():
+    """statement_needed_names mirrors the planner's scoped-star pruning:
+    a star over a derived table disables nothing, a star over a catalog
+    table adds that table's columns, an unresolvable star disables."""
+    from nds_tpu.analysis.mem_audit import statement_needed_names
+    from nds_tpu.sql.parser import parse
+    got = statement_needed_names(parse(
+        "with v as (select d_year y from date_dim) select * from v"))
+    assert got is not None and "d_year" in got and "d_moy" not in got
+    # a qualified star over an ALIASED CTE reference is still derived —
+    # it must not disable pruning for the whole statement
+    got = statement_needed_names(parse(
+        "with v as (select d_year y from date_dim) select x.* from v x"))
+    assert got is not None and "d_moy" not in got
+    got = statement_needed_names(parse("select * from warehouse"))
+    assert got is not None and "w_warehouse_sq_ft" in got
+    got = statement_needed_names(parse("select t.* from nowhere t"))
+    assert got is None
+
+
+def test_mem_audit_env_knobs_read_at_model_build(monkeypatch):
+    """MemModel reads NDS_TPU_HBM_BYTES / STREAM_ACC_ROWS / FANOUT at
+    construction, not import — the same build-time discipline the
+    executor follows."""
+    from nds_tpu.analysis.mem_audit import MemModel, hbm_capacity_bytes
+    monkeypatch.setenv("NDS_TPU_HBM_BYTES", "12345")
+    monkeypatch.setenv("NDS_TPU_STREAM_ACC_ROWS", "777")
+    monkeypatch.setenv("NDS_TPU_STREAM_FANOUT", "8")
+    m = MemModel()
+    assert hbm_capacity_bytes() == 12345
+    assert m.capacity_bytes == 12345
+    assert m.acc_ceiling == 777
+    assert m.fanout == 8
+
+
+def test_mem_audit_differential_harness():
+    """The soundness contract: measured survivor/output counts must fit
+    the static bounds on the A/B templates, and the harness must FAIL on
+    the injected drift fixture (zeroed bounds)."""
+    path = os.path.join(REPO, "tools", "mem_audit_diff.py")
+    spec = importlib.util.spec_from_file_location("mem_audit_diff", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    queries, _ = mod._load_ab_templates()
+    evidence, bounds = mod.collect_runtime_evidence()
+    assert bounds["store_sales"] == 20_000      # the toy session's truth
+    reports = mod.predict(queries, bounds)
+    ok, lines = mod.compare(reports, evidence)
+    assert ok, "\n".join(lines)
+    drift_ok, drift_lines = mod.compare(reports, evidence,
+                                        inject_drift=True)
+    assert not drift_ok, "drift fixture failed to fail"
+    assert any("UNSOUND" in ln for ln in drift_lines)
+
+
+# ---------------------------------------------------------------------------
 # baseline diffing + CI gate
 # ---------------------------------------------------------------------------
 
@@ -927,17 +1109,21 @@ def test_lint_cli_format_json(tmp_path):
     doc = json.loads(r.stdout)
     assert doc["version"] == 1
     assert set(doc["pass_counts"]) == {"plan-audit", "exec-audit",
-                                       "jax-lint", "driver-audit"}
+                                       "mem-audit", "jax-lint",
+                                       "driver-audit"}
     entries = doc["findings"]
     assert entries == sorted(
         entries, key=lambda e: (e["rule"], e["file"], e["symbol"]))
     for e in entries:
         assert set(e) == {"rule", "file", "symbol", "severity", "count",
                           "baselined"}
-    # the shipped tree is fully baselined: the q77 cartesian and nothing new
+    # the shipped tree is fully baselined: the q77 cartesian plus the 7
+    # accepted hbm-capacity accumulator bounds (fan-out joins whose
+    # enforced pair-bucket bound exceeds the 16 GiB capacity model — the
+    # worklist for partitioned/spilling accumulation), nothing new
     assert doc["new"] == 0
     assert [(e["rule"], e["baselined"]) for e in entries] == \
-        [("cartesian-join", True)]
+        [("cartesian-join", True)] + [("hbm-capacity", True)] * 7
     # a failing corpus keeps stdout pure JSON and still exits 2
     seeded = tmp_path / "templates"
     shutil.copytree(TEMPLATES, seeded)
@@ -960,6 +1146,23 @@ def test_lint_cli_stream_report():
         assert klass in r.stdout
     # the report is the widening worklist: eager scans carry reason codes
     assert "subquery-residual" in r.stdout
+
+
+def test_lint_cli_mem_report():
+    r = _run_lint("--mem-report")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "per-statement peak-HBM byte bounds" in r.stdout
+    assert "capacity model" in r.stdout
+    # provable accumulators print their row bound; unprovable scans name
+    # the eager loop
+    assert "rows, k=" in r.stdout
+    assert "unprovable (eager loop)" in r.stdout
+    # --format json keeps stdout a single document with the report inline
+    r = _run_lint("--mem-report", "--format", "json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert len(doc["mem_report"]) >= 99
+    assert all(e["peak_bytes"] > 0 for e in doc["mem_report"])
 
 
 def test_lint_cli_changed_fast_path():

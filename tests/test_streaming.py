@@ -88,6 +88,129 @@ def test_two_streamed_tables_one_axis():
     assert resident.sql(sql).collect() == streamed.sql(sql).collect()
 
 
+def test_padded_chunks_capacity_edges():
+    """ChunkedTable.padded_chunks at the capacity boundaries the compiled
+    pipeline (and mem_audit's width model) depends on: exact power-of-two
+    fits, one-past-the-boundary short chunks, non-power-of-two chunk_rows
+    rounding, single-row and empty tables — every chunk at ONE uniform
+    capacity with explicit validity and a single shared string
+    dictionary."""
+    from nds_tpu.analysis.mem_audit import type_width
+    from nds_tpu.engine.ops import bucket_len
+
+    def tbl(n):
+        return pa.table({
+            "v": pa.array(np.arange(n), pa.int64()),
+            "s": pa.array([f"x{i % 3}" for i in range(n)], pa.string())})
+
+    # exact power-of-two boundary: one full chunk, no pad rows
+    ct = ChunkedTable(tbl(1024), chunk_rows=1024)
+    chunks = list(ct.padded_chunks())
+    assert len(chunks) == 1 and ct.num_chunks() == 1
+    c = chunks[0]
+    assert c.plen == ct.chunk_cap == bucket_len(1024) == 1024
+    assert int(c.nrows) == 1024
+    assert bool(np.asarray(c["v"].valid).all())
+    # one row past the boundary: a second chunk with a single live row,
+    # zero-padded to the SAME capacity (validity False past the prefix)
+    ct = ChunkedTable(tbl(1025), chunk_rows=1024)
+    chunks = list(ct.padded_chunks())
+    assert [int(c.nrows) for c in chunks] == [1024, 1]
+    assert chunks[-1].plen == 1024
+    assert int(np.asarray(chunks[-1]["v"].valid).sum()) == 1
+    # non-power-of-two chunk_rows round up to one shared capacity while
+    # slicing exactly chunk_rows live rows per chunk (final chunk short)
+    ct = ChunkedTable(tbl(2500), chunk_rows=800)
+    chunks = list(ct.padded_chunks())
+    assert [c.plen for c in chunks] == [1024] * 4
+    assert [int(c.nrows) for c in chunks] == [800, 800, 800, 100]
+    # every chunk shares ONE string dictionary object (identity: the
+    # whole-table encoding — per-chunk dictionaries would make the same
+    # code mean different strings chunk to chunk)
+    assert len({id(c["s"].dict_values) for c in chunks}) == 1
+    # pytree uniformity: same kinds, validity present on every column
+    assert len({tuple((n, c[n].kind, c[n].valid is not None)
+                      for n in c.column_names) for c in chunks}) == 1
+    # the widths mem_audit prices are exactly what a padded chunk holds
+    assert c["v"].data.dtype.itemsize + 1 == type_width("int64")
+    assert chunks[0]["s"].data.dtype.itemsize + 1 == type_width("string")
+    # single-row and empty tables still yield one full-capacity chunk
+    for n in (1, 0):
+        ct = ChunkedTable(tbl(n), chunk_rows=1024)
+        chunks = list(ct.padded_chunks())
+        assert len(chunks) == 1 and chunks[0].plen == 1024
+        assert int(chunks[0].nrows) == n
+        assert int(np.asarray(chunks[0]["v"].valid).sum()) == n
+
+
+def test_acc_ceiling_env_read_at_build_time(monkeypatch, tmp_path):
+    """Regression for the import-time env freeze: NDS_TPU_STREAM_ACC_ROWS
+    set AFTER module import must clamp the accumulator at pipeline build
+    (forcing the overflow rerun), the rerun must emit the
+    stream.overflow-rerun span (priced by tools/trace_report.py), and
+    removing the ceiling must restore the proof-sized compiled path."""
+    import importlib.util
+    import os as _os
+    import sys as _sys
+
+    from nds_tpu.engine import ops as E
+    from nds_tpu.listener import drain_stream_events
+    from nds_tpu.obs import export as obs_export
+    from nds_tpu.obs import trace as obs_trace
+
+    monkeypatch.setenv("NDS_TPU_STREAM_FANOUT", "16")
+    assert E.stream_fanout() == 16       # read at use time, not import
+    monkeypatch.delenv("NDS_TPU_STREAM_FANOUT")
+
+    sales, _items, _dates = _tables()    # 5000 rows
+    sql = "select s_item, s_qty from sales order by s_item, s_qty"
+    resident = Session()
+    resident.create_temp_view("sales", sales, base=True)
+    expect = resident.sql(sql).collect()
+
+    # ceiling far below the 5000 survivors: the proof is overridden by
+    # the explicit hard ceiling, the accumulator overflows, and the
+    # query reruns eagerly — bit-identical results either way
+    monkeypatch.setenv("NDS_TPU_STREAM_ACC_ROWS", "1024")
+    s = Session()
+    s.create_temp_view("sales", ChunkedTable(sales, chunk_rows=800),
+                       base=True)
+    drain_stream_events()
+    obs_trace.drain_spans()
+    assert s.sql(sql).collect() == expect
+    events = drain_stream_events()
+    assert [e.path for e in events] == ["eager"]
+    assert events[0].reason == "bound-bucket overflow"
+    records = obs_trace.drain_spans()
+    names = [r.name for r in records
+             if isinstance(r, obs_trace.SpanRecord)]
+    assert "stream.overflow-rerun" in names
+    assert "stream.eager" not in names
+    # trace_report prices the rerun separately from ordinary fallbacks
+    tdir = tmp_path / "traces"
+    tdir.mkdir()
+    obs_export.write_chrome_trace(str(tdir / "q.trace.json"), records,
+                                  query="q")
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", _os.path.join(repo, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = "\n".join(mod.report(str(tdir)))
+    assert "bound-bucket overflow" in out and "overflow rerun:" in out
+
+    # ceiling removed: the proof sizes the accumulator and the SAME
+    # statement streams compiled, keeping every survivor
+    monkeypatch.delenv("NDS_TPU_STREAM_ACC_ROWS")
+    s2 = Session()
+    s2.create_temp_view("sales", ChunkedTable(sales, chunk_rows=800),
+                        base=True)
+    assert s2.sql(sql).collect() == expect
+    events = drain_stream_events()
+    assert [e.path for e in events] == ["compiled"]
+    assert events[0].rows == 5000        # survivor count on the event
+
+
 def test_session_stream_threshold(monkeypatch, tmp_path):
     """read_columnar_view streams tables past the byte threshold."""
     import pyarrow.parquet as pq

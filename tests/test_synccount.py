@@ -241,6 +241,19 @@ _STREAM_AB_QUERIES = [
     ("""select count(*) c, sum(ss_ext_sales_price) s from store_sales
         where ss_sold_date_sk in
               (select d_date_sk from date_dim where d_moy = 11)""", False),
+    # --- bare scans (no filter, no join: the survivor accumulator keeps
+    # every chunk row). Formerly `accumulator-overflow` eager fallbacks;
+    # the static memory proof (analysis/mem_audit.py) now sizes the
+    # accumulator from the statement's row bound, so they stream compiled
+    # and exec_audit reclassifies them in lockstep.
+    ("""select ss_item_sk, ss_ext_sales_price from store_sales
+        order by ss_item_sk, ss_ext_sales_price""", True),
+    # bare keyless aggregate over the whole streamed fact
+    ("""select count(*) c, sum(ss_ext_sales_price) s, min(ss_item_sk) m
+        from store_sales""", True),
+    # bare grouped aggregate, no WHERE
+    ("""select ss_sold_date_sk, count(*) c from store_sales
+        group by ss_sold_date_sk order by ss_sold_date_sk""", True),
 ]
 
 

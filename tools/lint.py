@@ -1,7 +1,7 @@
 # Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
-"""Static analysis gate: plan auditor + exec auditor + engine/driver lint.
+"""Static analysis gate: plan/exec/mem auditors + engine/driver lint.
 
-Runs the four :mod:`nds_tpu.analysis` passes entirely on host (no device,
+Runs the five :mod:`nds_tpu.analysis` passes entirely on host (no device,
 no data) and exits nonzero when any finding is NOT covered by the
 checked-in baseline (``nds_tpu/analysis/baseline.json``) — the accepted
 pre-existing findings. New code must come in clean; accepting a new
@@ -15,6 +15,8 @@ Usage:
                                               # stdout (CI annotation)
     python tools/lint.py --stream-report      # per-template execution-path
                                               # classification (exec-audit)
+    python tools/lint.py --mem-report         # per-statement peak-HBM byte
+                                              # bounds (mem-audit)
     python tools/lint.py --changed            # lint only files in the
                                               # current git diff
     python tools/lint.py --templates DIR      # audit a different corpus
@@ -46,6 +48,10 @@ from nds_tpu.analysis.exec_audit import (audit_exec_corpus,  # noqa: E402
                                          format_stream_report,
                                          reports_to_findings)
 from nds_tpu.analysis.jax_lint import lint_file, lint_tree  # noqa: E402
+from nds_tpu.analysis.mem_audit import (audit_mem_corpus,  # noqa: E402
+                                        format_mem_report)
+from nds_tpu.analysis.mem_audit import \
+    reports_to_findings as mem_reports_to_findings  # noqa: E402
 from nds_tpu.analysis.plan_audit import audit_corpus  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -81,12 +87,15 @@ _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
 
 def run_passes(template_dir=None, changed=None, want_reports=False):
     """Run the analysis passes; ``changed`` (repo-relative paths) restricts
-    the fast path to affected files only. Returns (findings, pass counts,
-    exec reports, elapsed seconds)."""
+    the fast path to affected files only (edits under any _CORPUS_ROOTS
+    prefix — schema.py, engine/, analysis/, sql/, queries/ — rerun the
+    corpus-level audits, mem-audit included). Returns (findings, pass
+    counts, exec reports, mem reports, elapsed seconds)."""
     t0 = time.time()
     findings = []
     counts = {}
     reports = []
+    mem_reports = []
     corpus_affected = (
         changed is None or template_dir is not None or want_reports
         or any(c.startswith(_CORPUS_ROOTS) for c in changed))
@@ -94,6 +103,10 @@ def run_passes(template_dir=None, changed=None, want_reports=False):
     def run_exec():
         reports.extend(audit_exec_corpus(template_dir))
         return reports_to_findings(reports)
+
+    def run_mem():
+        mem_reports.extend(audit_mem_corpus(template_dir))
+        return mem_reports_to_findings(mem_reports)
 
     def run_jax():
         if changed is None:
@@ -120,13 +133,14 @@ def run_passes(template_dir=None, changed=None, want_reports=False):
     if corpus_affected:
         passes.append(("plan-audit", lambda: audit_corpus(template_dir)))
         passes.append(("exec-audit", run_exec))
+        passes.append(("mem-audit", run_mem))
     passes.append(("jax-lint", run_jax))
     passes.append(("driver-audit", run_drivers))
     for name, fn in passes:
         got = fn()
         counts[name] = len(got)
         findings.extend(got)
-    return findings, counts, reports, time.time() - t0
+    return findings, counts, reports, mem_reports, time.time() - t0
 
 
 def _aggregate(findings, new):
@@ -164,6 +178,9 @@ def main(argv=None) -> int:
     ap.add_argument("--stream-report", action="store_true",
                     help="print the exec-audit per-template execution-path "
                     "classification (the streamability worklist)")
+    ap.add_argument("--mem-report", action="store_true",
+                    help="print the mem-audit per-statement peak-HBM "
+                    "byte bounds and stream-accumulator proofs")
     ap.add_argument("--changed", action="store_true",
                     help="fast path: lint only files in the current git "
                     "diff (full run when not in a git checkout)")
@@ -185,8 +202,9 @@ def main(argv=None) -> int:
 
     changed = git_changed_files() if args.changed else None
 
-    findings, counts, reports, elapsed = run_passes(
-        args.templates, changed=changed, want_reports=args.stream_report)
+    findings, counts, reports, mem_reports, elapsed = run_passes(
+        args.templates, changed=changed,
+        want_reports=args.stream_report or args.mem_report)
 
     # diff against the PRE-update baseline so a --json report written
     # alongside --update-baseline shows what was just accepted
@@ -203,6 +221,8 @@ def main(argv=None) -> int:
         }
         if reports:
             doc["stream_report"] = [r.to_dict() for r in reports]
+        if mem_reports:
+            doc["mem_report"] = [r.to_dict() for r in mem_reports]
         with open(args.json, "w") as f:
             json.dump(doc, f, indent=1)
 
@@ -215,10 +235,12 @@ def main(argv=None) -> int:
     out = sys.stderr if args.format == "json" else sys.stdout
 
     # under --format json stdout must stay a single parseable JSON
-    # document: the human table moves to stderr and the classification
-    # rides in the document's "stream_report" field instead
+    # document: the human tables move to stderr and the classifications
+    # ride in the document's "stream_report"/"mem_report" fields instead
     if args.stream_report and reports:
         print(format_stream_report(reports), file=out)
+    if args.mem_report and mem_reports:
+        print(format_mem_report(mem_reports), file=out)
     for f in new:
         print(f"NEW {f}", file=out)
     n_err = sum(1 for f in new if f.severity == "error")
@@ -232,6 +254,8 @@ def main(argv=None) -> int:
                "findings": _aggregate(findings, new)}
         if args.stream_report and reports:
             doc["stream_report"] = [r.to_dict() for r in reports]
+        if args.mem_report and mem_reports:
+            doc["mem_report"] = [r.to_dict() for r in mem_reports]
         print(json.dumps(doc, indent=1))
     if new:
         print("# gate FAILED: fix the findings above, suppress with "

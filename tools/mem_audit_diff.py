@@ -1,0 +1,208 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Differential validation of the static memory auditor (soundness).
+
+The mem auditor (``nds_tpu/analysis/mem_audit.py``) proves per-statement
+row/byte bounds that the streaming executor now SIZES ITS SURVIVOR
+ACCUMULATORS from — an unsound bound would silently drop rows on device
+(the overflow flag only fires past the allocated capacity, so the
+capacity itself must dominate the true survivor count). This harness is
+the checked contract, mirroring ``tools/exec_audit_diff.py``:
+
+* replay the ``tests/test_synccount.py`` A/B templates — the same
+  statements whose runtime behavior tier-1 pins — through the real
+  engine on the chunked toy session, cold and warm;
+* build the static predictions from a :class:`MemModel` parameterized
+  with the toy session's REAL row counts (the audit's SF10 table is a
+  stand-in for exactly this knowledge);
+* fail when runtime evidence ever exceeds a static bound:
+
+  - a compiled streamed scan's measured survivor count
+    (``StreamEvent.rows``, the accumulator's final total) must be
+    <= the scan's proven accumulator row bound;
+  - a statement's materialized output row count must be <= the
+    statement's ``out_rows`` bound (joins bounded by schema key
+    uniqueness, group-bys by key domains — the rules DESIGN.md's
+    "Static memory model" table documents);
+  - every statement must carry a finite bound, and every scan the
+    model calls *provable* must actually have taken the compiled path
+    (a provable bound that the executor rejects means the model and
+    ``stream_graph_fanout`` drifted apart).
+
+``--inject-drift`` zeroes every predicted bound before comparing — a
+model-drift fixture that MUST fail, proving the harness can catch an
+under-bounding model (``tests/test_analysis.py`` asserts both
+directions). Run it after any change to the planner's join bounds,
+``ChunkedTable`` chunk shapes, ``engine/stream.py`` accumulator sizing,
+or the schema widths: the static model and the executor are kept in
+lockstep the same way ``exec_audit`` tracks the stream routing.
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_ab_templates():
+    """The canonical A/B statements + the chunked toy session builder,
+    imported by path from tests/test_synccount.py so the harness and the
+    tier-1 budget tests share one set of fixtures by construction."""
+    path = os.path.join(REPO, "tests", "test_synccount.py")
+    spec = importlib.util.spec_from_file_location("_synccount_fixtures",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod._STREAM_AB_QUERIES, mod._chunked_star_session
+
+
+def _session_row_bounds(session) -> dict:
+    """The toy session's real per-table row counts — the cardinality
+    knowledge a live audit would read off the arrow metadata."""
+    bounds = {}
+    for name, t in session.catalog.items():
+        bounds[name.lower()] = int(t.nrows) if isinstance(t.nrows, int) \
+            else int(t.arrow.num_rows)
+    return bounds
+
+
+def predict(queries, row_bounds):
+    from nds_tpu.analysis.mem_audit import MemAuditor, MemModel
+    model = MemModel(row_bounds=row_bounds)
+    auditor = MemAuditor(streamed={"store_sales"}, model=model)
+    return [auditor.audit_sql(sql, query=f"ab{i + 1}")
+            for i, (sql, _must) in enumerate(queries)]
+
+
+def collect_runtime_evidence():
+    """Execute each A/B template twice (cold: record+compile; warm:
+    pipeline-cache hit) and return per-template evidence plus the toy
+    session's row bounds."""
+    import numpy as np
+
+    from nds_tpu.listener import drain_stream_events
+
+    queries, make_session = _load_ab_templates()
+    session = make_session(np.random.default_rng(42))
+    bounds = _session_row_bounds(session)
+    drain_stream_events()
+    evidence = []
+    for sql, _must in queries:
+        runs = []
+        for sight in ("cold", "warm"):
+            rows = session.sql(sql).collect()
+            events = drain_stream_events()
+            runs.append({
+                "sight": sight,
+                "out_rows": len(rows),
+                "paths": [e.path for e in events],
+                "survivors": [e.rows for e in events
+                              if e.path == "compiled" and e.rows >= 0],
+            })
+        evidence.append({"sql": sql, "cold": runs[0], "warm": runs[1]})
+    return evidence, bounds
+
+
+def compare(reports, evidence, inject_drift=False):
+    """Check static bounds against runtime evidence; returns (ok, lines).
+    ``inject_drift`` zeroes every predicted bound first — the self-test
+    fixture that must produce violations."""
+    ok = True
+    lines = []
+    for rep, ev in zip(reports, evidence):
+        acc_bounds = [s.acc_rows for s in rep.scans if s.provable]
+        out_bound = rep.out_rows
+        if inject_drift:
+            acc_bounds = [0 for _ in acc_bounds]
+            out_bound = 0
+        head = (f"[{rep.query}] mode={rep.mode} "
+                f"peak={rep.peak_bytes:,}B out<={out_bound:,}")
+        problems = []
+        if rep.mode == "unknown":
+            problems.append(f"no finite bound: {rep.detail}")
+        if rep.peak_bytes <= 0:
+            problems.append("peak bound is not positive")
+        for sight in ("cold", "warm"):
+            r = ev[sight]
+            if r["out_rows"] > max(out_bound, 0):
+                problems.append(
+                    f"{sight} materialized {r['out_rows']} output rows > "
+                    f"static out_rows bound {out_bound} (UNSOUND)")
+            if not inject_drift and \
+                    len(r["survivors"]) < len(acc_bounds):
+                # the model proved a bound the executor did not use: a
+                # provable scan fell back eager (or its StreamEvent lost
+                # the survivor count) — routing and proof drifted apart
+                problems.append(
+                    f"{sight} ran {len(r['survivors'])} compiled scans "
+                    f"with survivor evidence, but the model proved "
+                    f"{len(acc_bounds)} accumulator bounds (model drift)")
+            for i, got in enumerate(r["survivors"]):
+                bound = acc_bounds[i] if i < len(acc_bounds) else None
+                if bound is None:
+                    # the executor streamed a scan the model calls
+                    # unprovable: the proof is stale vs the routing
+                    problems.append(
+                        f"{sight} compiled scan #{i} has no provable "
+                        "static accumulator bound (model drift)")
+                elif got > bound:
+                    problems.append(
+                        f"{sight} accumulator kept {got} survivor rows > "
+                        f"static bound {bound} (UNSOUND: the proof-sized "
+                        "accumulator would have dropped rows)")
+        if not ev["warm"]["out_rows"]:
+            problems.append("A/B template unexpectedly returned no rows")
+        if problems:
+            ok = False
+            lines.append(f"MISMATCH {head}")
+            lines.extend(f"    {p}" for p in problems)
+        else:
+            survivors = ev["warm"]["survivors"]
+            lines.append(
+                f"ok {head} :: warm survivors {survivors} <= "
+                f"{acc_bounds} acc bound, {ev['warm']['out_rows']} rows "
+                f"out via {ev['warm']['paths']}")
+    return ok, lines
+
+
+def run_diff(inject_drift=False):
+    """Full harness: execute, predict from real counts, compare."""
+    queries, _ = _load_ab_templates()
+    evidence, bounds = collect_runtime_evidence()
+    reports = predict(queries, bounds)
+    return compare(reports, evidence, inject_drift=inject_drift)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="differential validation: static mem-audit bounds vs "
+        "runtime survivor/output evidence (soundness)")
+    ap.add_argument("--inject-drift", action="store_true",
+                    help="zero every predicted bound before comparing: "
+                    "the harness must FAIL (model-drift self-test)")
+    args = ap.parse_args(argv)
+    ok, lines = run_diff(inject_drift=args.inject_drift)
+    for ln in lines:
+        print(ln)
+    if args.inject_drift:
+        if ok:
+            print("# DRIFT FIXTURE FAILED TO FAIL: the harness cannot "
+                  "detect an under-bounding model")
+            return 1
+        print("# drift fixture correctly rejected (harness is live)")
+        return 0
+    if ok:
+        print("# mem-audit differential: every measured survivor/output "
+              "count fits its static bound")
+        return 0
+    print("# mem-audit differential FAILED: update the static model in "
+          "nds_tpu/analysis/mem_audit.py in lockstep with the engine")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,11 +26,15 @@ import sys
 from collections import Counter, defaultdict
 
 # phase columns of the breakdown table, in pipeline order; everything
-# else (query/stream umbrellas, uncovered wall) folds into "other"
+# else (query/stream umbrellas, uncovered wall) folds into "other".
+# stream.overflow-rerun is the eager re-execution after a completed
+# compiled run overflowed its bound buckets — its cost is priced
+# separately in the fallback ranking (the wasted pipeline time is the
+# stream span's remainder).
 PHASES = ("plan", "replay.record", "replay.compile", "replay.drive",
           "stream.record", "stream.compile", "stream.prefetch",
-          "stream.drive", "stream.eager", "stream.materialize",
-          "materialize")
+          "stream.drive", "stream.eager", "stream.overflow-rerun",
+          "stream.materialize", "materialize")
 
 
 def self_times(events):
@@ -66,7 +70,8 @@ def report(trace_dir, top=10):
     per_query = {}
     sites = Counter()
     site_tag = {}
-    fallbacks = defaultdict(lambda: {"queries": 0, "ms": 0.0, "syncs": 0})
+    fallbacks = defaultdict(lambda: {"queries": 0, "ms": 0.0, "syncs": 0,
+                                     "rerun_ms": 0.0})
     for path in files:
         query, events = load_trace(path)
 
@@ -95,6 +100,11 @@ def report(trace_dir, top=10):
                 fb["queries"] += 1
                 fb["ms"] += e["dur"] / 1e3
                 fb["syncs"] += args.get("syncs", 0)
+            if name == "stream.overflow-rerun":
+                # an overflow rerun's eager loop: the enclosing stream
+                # span's remainder is the WASTED compiled-pipeline work
+                fb = fallbacks[args.get("reason", "bound-bucket overflow")]
+                fb["rerun_ms"] += e["dur"] / 1e3
         # wall from the top-level (non-contained) spans only, so nested
         # phases never double-count into the query total; syncs from the
         # attributed sync-site slices — each charged sync appears on
@@ -139,8 +149,13 @@ def report(trace_dir, top=10):
         ranked = sorted(fallbacks.items(),
                         key=lambda kv: kv[1]["ms"], reverse=True)
         for reason, fb in ranked:
+            extra = ""
+            if fb["rerun_ms"]:
+                wasted = max(fb["ms"] - fb["rerun_ms"], 0.0)
+                extra = (f"  (overflow rerun: {fb['rerun_ms']:.1f} ms "
+                         f"eager + {wasted:.1f} ms wasted pipeline)")
             lines.append(f"  {fb['ms']:9.1f} ms  {fb['syncs']:4d} syncs  "
-                         f"{fb['queries']:3d} scans  {reason}")
+                         f"{fb['queries']:3d} scans  {reason}{extra}")
     else:
         lines.append("# no eager-fallback streamed scans in this run")
     return lines
