@@ -225,12 +225,9 @@ def run_server():
                 # >HBM streamed scans: which path served each (compiled
                 # chunk pipeline vs eager chunk loop), chunk/sync counts
                 # — the per-query face of the streamed sync budget
+                from nds_tpu.listener import stream_event_json
                 result["streamedScans"] = [
-                    {"table": e.where, "chunks": e.chunks,
-                     "syncs": e.syncs, "path": e.path,
-                     **({"rows": e.rows} if e.rows >= 0 else {}),
-                     **({"reason": e.reason} if e.reason else {})}
-                    for e in stream_events]
+                    stream_event_json(e) for e in stream_events]
             if trace_records:
                 # per-phase attribution of the final timed pass (obs
                 # layer; zero added syncs): plan vs drive vs materialize
@@ -439,8 +436,16 @@ def emit(times, n_total, aborted=None):
         print(json.dumps(out))
         return
     geomean = _geomean(list(times.values()))
-    vs = resolve_baseline(os.path.join(REPO, "BASELINE_TIMES.json"),
-                          times, n_total)
+    try:
+        vs = resolve_baseline(os.path.join(REPO, "BASELINE_TIMES.json"),
+                              times, n_total)
+    except Exception as exc:
+        # the metric line must survive a baseline-write failure — this
+        # path also runs from the SIGTERM handler of an externally
+        # timed-out campaign, where losing the partial geomean repeats
+        # BENCH_r05's {"value": null} artifact
+        print(f"# baseline update failed: {exc}", file=sys.stderr)
+        vs = 0.0
     out = {
         "metric": "power_geomean_ms",
         "value": round(geomean, 3),
@@ -451,6 +456,21 @@ def emit(times, n_total, aborted=None):
     if aborted:
         out["aborted"] = aborted
     print(json.dumps(out), flush=True)
+
+
+def finalize(times, perf, n_total, platform="unknown", aborted=None):
+    """Flush everything the campaign measured so far: the PERF.md
+    roofline table and the one JSON metric line. Runs at normal end AND
+    from the SIGTERM/SIGINT handler, so an external ``timeout`` kill
+    (rc=124) still records the partial geomean of every completed query
+    instead of BENCH_r05's ``{"value": null, "n_queries": 0}``. Each
+    step is isolated: a PERF.md write failure must not eat the metric
+    line."""
+    try:
+        write_perf(times, perf, platform)
+    except Exception as exc:
+        print(f"# PERF.md write failed: {exc}", file=sys.stderr)
+    emit(times, n_total, aborted)
 
 
 def load_resume(path, times, perf):
@@ -496,9 +516,20 @@ def run_parent(t_entry):
     resume_f = None
     if resume_path:
         resume_f = open(resume_path, "a")
+    # defined BEFORE the handlers register: a kill during data
+    # generation must find every name the handler reads
+    platform = resume_platform or "unknown"
 
     def on_signal(signum, frame):
-        emit(times, len(names))
+        # an external `timeout` kill lands here: flush the completed
+        # per-query results (PERF.md + partial-geomean metric line +
+        # resume JSONL) before the -k SIGKILL grace runs out
+        finalize(times, perf, len(names), platform)
+        if resume_f is not None:
+            try:
+                resume_f.close()
+            except OSError:
+                pass
         child.stop()          # free the device attachment before exiting
         os._exit(0)
 
@@ -519,7 +550,6 @@ def run_parent(t_entry):
         print(f"# resume: {len(times)} queries pre-loaded from "
               f"{os.path.basename(resume_path)}", file=sys.stderr)
     attempts = {}
-    platform = resume_platform or "unknown"
     aborted = None
     setup_fails = 0
     while pending and left() > 0:
@@ -589,8 +619,7 @@ def run_parent(t_entry):
     if times and len(times) < len(names):
         print(f"# measured {len(times)}/{len(names)} queries",
               file=sys.stderr)
-    write_perf(times, perf, platform)
-    emit(times, len(names), aborted)
+    finalize(times, perf, len(names), platform, aborted)
     if not times:
         sys.exit(1)
 

@@ -63,6 +63,21 @@ with no device in the loop, answers for every template:
    ``first_sight`` and are NOT gated: they amortize across a Power Run's
    2-4 executions the same way XLA compiles do.
 
+   **The partition pass costs zero syncs.** A graph whose proven
+   accumulator bound is past the capacity model runs the grace-style
+   PARTITIONED pipeline (``engine/stream.py``): an extra jitted pass
+   hashes every chunk row to a partition (histogram device-resident),
+   each partition dispatches into its own accumulator, and the single
+   materializing sync fetches every partition's count + flag in ONE
+   transfer — so a partitioned statement's sync bound is IDENTICAL to
+   the unpartitioned one and no classification moves. That zero is a
+   checked contract: ``tools/exec_audit_diff.py`` drives the fan-out
+   A/B templates through the partitioned pipeline (forced
+   ``NDS_TPU_STREAM_PARTITIONS``) and fails if any ``stream.partition``
+   span ever charges a host sync. The per-partition memory bounds
+   themselves live in :mod:`nds_tpu.analysis.mem_audit` (the
+   ``hbm-capacity`` gate + ``--mem-report``).
+
 **Trace instrumentation is sync-free.** The obs span layer
 (:mod:`nds_tpu.obs`) wraps the instrumented phases in host-clock spans
 that read only the thread's existing sync/wait/compile counters, so the

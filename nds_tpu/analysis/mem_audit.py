@@ -45,6 +45,27 @@ every template:
    overflow rerun, and `exec_audit` reclassifies its former
    ``accumulator-overflow`` fallback to ``compiled-stream`` in lockstep.
 
+3. **When the whole-statement bound exceeds capacity, can a grace-style
+   partition decomposition admit it?** A streamed graph whose survivor
+   bound is past ``NDS_TPU_HBM_BYTES`` but which joins on plain equi
+   keys is hash-partitioned by the executor: every chunk row lands in
+   exactly one of ``P`` partitions (join-key hash), each partition
+   drives the same compiled per-chunk program into its OWN accumulator,
+   and the *per-partition bound* is
+   ``min(n_chunks × per-chunk bucket × fanout^k,
+   bucket_len(ceil(rows / P) × skew) × fanout^k)``
+   (:func:`partition_row_bound`; ``skew`` = ``NDS_TPU_STREAM_SKEW``,
+   default 2 — hash partitions are only probabilistically even, so the
+   proof is skew-conditional and the runtime ENFORCES it with a
+   per-partition overflow flag: a hotter-than-assumed partition reruns
+   eagerly, correctness never rides the proof). The partition count is
+   chosen STATICALLY from the proof (:func:`choose_partitions` —
+   smallest power of two whose per-partition bound fits capacity;
+   ``NDS_TPU_STREAM_PARTITIONS`` pins it), so it joins the pipeline
+   cache key. The ``hbm-capacity`` gate then tests the per-partition
+   bound — which is what retired the 7 fan-out findings
+   (q17/q24×2/q25/q29/q64/q72) from the baseline.
+
 The capacity model is ``NDS_TPU_HBM_BYTES`` (default 16 GiB, one v5-lite
 chip); the cardinality model is a conservative SF10 row-bound table
 (:data:`DEFAULT_ROW_BOUNDS`), both parameterizable per :class:`MemModel`.
@@ -330,6 +351,117 @@ def structural_row_bound(rows: int, k: int, fanout: int) -> int:
     return _bucket(max(int(rows), 1)) * (int(fanout) ** int(k))
 
 
+# ---------------------------------------------------------------------------
+# partitioned (grace-style) fan-out accumulation: the per-partition proof
+# ---------------------------------------------------------------------------
+
+# partition-count search ceiling: past 256 partitions the per-chunk
+# dispatch fan-out dominates any accumulator saving
+_MAX_PARTITIONS = 256
+
+
+def _pow2_at_least(n: int) -> int:
+    n = int(n)
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def stream_partitions_env() -> int | None:
+    """``NDS_TPU_STREAM_PARTITIONS``: pins the partition count of every
+    partitionable streamed graph (rounded up to a power of two; <= 1
+    disables partitioning). Unset = the proof chooses statically
+    (:func:`choose_partitions`). Read at model/pipeline BUILD time."""
+    env = os.environ.get("NDS_TPU_STREAM_PARTITIONS")
+    return _pow2_at_least(int(env)) if env else None
+
+
+def stream_skew_factor() -> int:
+    """``NDS_TPU_STREAM_SKEW``: the hash-skew safety factor of the
+    per-partition bound (default 2 — one partition may hold up to
+    ``skew ×`` its even share before the enforced overflow flag fires)."""
+    return max(int(os.environ.get("NDS_TPU_STREAM_SKEW", "2")), 1)
+
+
+def partition_row_bound(rows: int, n_partitions: int, k: int, fanout: int,
+                        skew: int | None = None) -> int:
+    """Per-partition survivor-row bound of a hash-partitioned streamed
+    graph: the structural bound of one partition's skew-factored row
+    share. Sound under the skew assumption; the runtime enforces it with
+    a per-partition overflow flag (overflow ⇒ eager rerun). Shared by
+    the audit and ``engine/stream.py`` — one definition, no drift."""
+    if skew is None:
+        skew = stream_skew_factor()
+    rows = max(int(rows), 1)
+    share = min(rows, -(-rows // max(int(n_partitions), 1)) * int(skew))
+    return structural_row_bound(share, k, fanout)
+
+
+def choose_partitions(rows: int, k: int, fanout: int, row_bytes: int,
+                      capacity_bytes: int, forced: int | None = None,
+                      skew: int | None = None):
+    """``(n_partitions, per_partition_row_bound)`` for one streamed graph.
+
+    ``forced`` (``NDS_TPU_STREAM_PARTITIONS``) pins the count; auto picks
+    the smallest power of two whose skew-factored per-partition
+    accumulator bound fits ``capacity_bytes`` — statically, so the count
+    can join the pipeline-cache key. ``(1, None)`` means unpartitioned:
+    either the whole bound already fits, or no count up to
+    ``_MAX_PARTITIONS`` admits it (the caller keeps today's legacy-clamp
+    behavior)."""
+    row_bytes = max(int(row_bytes), 1)
+    if forced is not None:
+        p = _pow2_at_least(forced)
+        if p <= 1:
+            return 1, None
+        return p, partition_row_bound(rows, p, k, fanout, skew)
+    if structural_row_bound(rows, k, fanout) * row_bytes <= capacity_bytes:
+        return 1, None
+    p = 2
+    while p <= _MAX_PARTITIONS:
+        bound = partition_row_bound(rows, p, k, fanout, skew)
+        if bound * row_bytes <= capacity_bytes:
+            return p, bound
+        p <<= 1
+    return 1, None
+
+
+def stream_partition_keys(part_cols, sources, keep, conjuncts):
+    """Bare chunk-side column names the partition hash keys on, or None
+    when the streamed graph is not partitionable (no plain-column equi
+    edge incident to the streamed slot — bare scans, expression-only
+    edges, subquery conjuncts).
+
+    Prefers a fan-out batch (no PK-unique side — the batch whose
+    multiplicity forced partitioning in the first place) so rows that
+    co-fan-out land in one partition; falls back to any incident equi
+    batch (any chunk-row partitioning keeps the per-partition bound
+    valid, since multiplicity is per-row). Deterministic: batches walk
+    in sorted part order, keys return sorted."""
+    batches: dict = {}
+    for c in conjuncts:
+        if _has_subquery(c):
+            return None
+        e = _equi_sides(c, part_cols)
+        if e is None:
+            continue
+        li, ri, _lk, _rk = e
+        batches.setdefault(tuple(sorted((li, ri))), []).append(e)
+    best = None
+    for (a, b) in sorted(batches):
+        if keep not in (a, b):
+            continue
+        batch = batches[(a, b)]
+        keys = sorted({(lk if li == keep else rk)
+                       for (li, ri, lk, rk) in batch
+                       if (lk if li == keep else rk) is not None})
+        if not keys:
+            continue
+        fan_out = not _batch_unique_side(part_cols, sources, keep,
+                                         a, b, batch)
+        if best is None or (fan_out and not best[0]):
+            best = (fan_out, tuple(keys))
+    return best[1] if best else None
+
+
 def statement_needed_names(stmt, catalog_cols: dict | None = None) \
         -> set | None:
     """Bare lowercase column names the statement references anywhere —
@@ -486,6 +618,9 @@ class MemModel:
             env = os.environ.get("NDS_TPU_STREAM_ACC_ROWS")
             acc_ceiling = int(env) if env else None
         self.acc_ceiling = acc_ceiling
+        # partitioned accumulation knobs (same build-time env discipline)
+        self.partitions = stream_partitions_env()  # None = proof-chosen
+        self.skew = stream_skew_factor()
         if catalog is None:
             catalog = {
                 t: {f.name.lower(): type_width(f.type) for f in fields}
@@ -521,6 +656,18 @@ class MemModel:
         n_chunks = max(1, math.ceil(stream_rows / self.chunk_rows))
         base = n_chunks * self.chunk_cap() * mult
         return min(base, structural_row_bound(stream_rows, k, self.fanout))
+
+    def partition_bound(self, stream_rows: int, k: int,
+                        n_partitions: int) -> int:
+        """Per-partition accumulator row bound: the tighter of the
+        per-chunk-bucket sum (each of a partition's dispatches still
+        contributes at most one chunk output bucket) and the
+        skew-factored structural share (:func:`partition_row_bound`)."""
+        mult = self.fanout ** k
+        n_chunks = max(1, math.ceil(stream_rows / self.chunk_rows))
+        base = n_chunks * self.chunk_cap() * mult
+        return min(base, partition_row_bound(stream_rows, n_partitions, k,
+                                             self.fanout, self.skew))
 
     def bare_scan_fits(self, table: str | None, needed: set | None) -> bool:
         """Can a bare streamed scan of ``table`` (no filter, no join: the
@@ -558,6 +705,9 @@ class ScanBound:
     acc_rows: int | None       # proven accumulator row bound (provable)
     acc_bytes: int | None      # acc_rows x streamed-graph row width
     chunk_bytes: int = 0       # one padded chunk's bytes (x2 in flight)
+    partitions: int = 1        # grace-style partition count (1 = whole)
+    part_rows: int | None = None   # per-partition accumulator row bound
+    part_bytes: int | None = None  # part_rows x streamed-graph row width
 
     @property
     def provable(self) -> bool:
@@ -589,6 +739,11 @@ class MemReport:
                        "acc_bytes": None if s.acc_bytes is None
                        else int(s.acc_bytes),
                        "chunk_bytes": int(s.chunk_bytes),
+                       "partitions": int(s.partitions),
+                       "part_rows": None if s.part_rows is None
+                       else int(s.part_rows),
+                       "part_bytes": None if s.part_bytes is None
+                       else int(s.part_bytes),
                        "provable": s.provable} for s in self.scans],
             "detail": self.detail,
         }
@@ -1029,24 +1184,56 @@ class MemAuditor:
         k = None if unprovable else stream_graph_fanout(
             part_cols, sources, keep, conjuncts)
         chunk_bytes = self.model.chunk_cap() * kept.width
+        n_parts, part_rows, part_bytes = 1, None, None
         if k is not None:
             acc_rows = self.model.acc_row_bound(kept.rows, k)
             if self.model.acc_ceiling is not None:
                 acc_rows = min(acc_rows, self.model.acc_ceiling)
             acc_bytes = acc_rows * merged.width
             survivors = min(joined_rows, acc_rows)
+            # grace-style partition decomposition: when the whole-graph
+            # bound is past capacity (or NDS_TPU_STREAM_PARTITIONS pins a
+            # count), a graph with plain equi keys on the streamed slot
+            # is proven per partition instead — the rule the executor
+            # mirrors at pipeline build (engine/stream.py)
+            forced = self.model.partitions
+            if (acc_bytes > self.model.capacity_bytes
+                    or (forced is not None and forced > 1)):
+                keys = stream_partition_keys(part_cols, sources, keep,
+                                             conjuncts)
+                if keys:
+                    p, _ = choose_partitions(
+                        kept.rows, k, self.model.fanout,
+                        max(merged.width, 1), self.model.capacity_bytes,
+                        forced=forced, skew=self.model.skew)
+                    if p > 1:
+                        n_parts = p
+                        part_rows = self.model.partition_bound(
+                            kept.rows, k, p)
+                        if self.model.acc_ceiling is not None:
+                            part_rows = min(part_rows,
+                                            self.model.acc_ceiling)
+                        part_bytes = part_rows * merged.width
         else:
             # eager loop: survivors concatenate up to the graph bound
             acc_rows = acc_bytes = None
             survivors = joined_rows
         sb = ScanBound(kept.alias, kept.source or "?", kept.rows, k,
-                       acc_rows, acc_bytes, chunk_bytes)
+                       acc_rows, acc_bytes, chunk_bytes,
+                       partitions=n_parts, part_rows=part_rows,
+                       part_bytes=part_bytes)
         cost.scans.append(sb)
-        # working set: two chunks in flight + the survivor accumulator
-        # (or, eager, the concatenated survivor union)
-        cost.peak += 2 * chunk_bytes + (
-            acc_bytes if acc_bytes is not None
-            else _bucket(max(survivors, 1)) * merged.width)
+        # working set: two chunks in flight + the survivor accumulator(s)
+        # (partitioned: every partition's proof-sized accumulator is live
+        # until the single materializing sync; eager: the concatenated
+        # survivor union)
+        if part_bytes is not None:
+            held = n_parts * part_bytes
+        elif acc_bytes is not None:
+            held = acc_bytes
+        else:
+            held = _bucket(max(survivors, 1)) * merged.width
+        cost.peak += 2 * chunk_bytes + held
         merged.rows = survivors
         return merged
 
@@ -1106,9 +1293,12 @@ def reports_to_findings(reports, capacity_bytes: int | None = None) -> list:
     audited scale, and a streamed statement whose proven accumulator
     bound exceeds it would be sized past HBM (the runtime would fall back
     to the legacy ceiling and risk the overflow rerun the proof exists to
-    retire). Eager-fallback scans (unprovable multiplicity) are reported
-    in ``--mem-report`` but not gated — the eager loop's working set is
-    per-chunk."""
+    retire). A PARTITIONED scan is gated on its per-partition bound
+    instead — the unit the executor allocates and the per-partition
+    overflow flag enforces; that rule is what cleared the 7 fan-out
+    accumulators from the baseline. Eager-fallback scans (unprovable
+    multiplicity) are reported in ``--mem-report`` but not gated — the
+    eager loop's working set is per-chunk."""
     cap = hbm_capacity_bytes() if capacity_bytes is None else capacity_bytes
     findings = []
     for r in reports:
@@ -1119,7 +1309,18 @@ def reports_to_findings(reports, capacity_bytes: int | None = None) -> list:
                 f"the configured HBM capacity {cap:,} B "
                 "(NDS_TPU_HBM_BYTES)"))
         for s in r.scans:
-            if s.provable and s.acc_bytes is not None and s.acc_bytes > cap:
+            if not s.provable:
+                continue
+            if s.partitions > 1 and s.part_bytes is not None:
+                if s.part_bytes > cap:
+                    findings.append(Finding(
+                        r.file, r.query, "hbm-capacity", "error",
+                        f"streamed scan {s.table!r} per-partition "
+                        f"accumulator bound {s.part_bytes:,} B "
+                        f"({s.part_rows:,} rows x {s.partitions} "
+                        f"partitions) exceeds the configured HBM "
+                        f"capacity {cap:,} B"))
+            elif s.acc_bytes is not None and s.acc_bytes > cap:
                 findings.append(Finding(
                     r.file, r.query, "hbm-capacity", "error",
                     f"streamed scan {s.table!r} accumulator bound "
@@ -1154,7 +1355,11 @@ def format_mem_report(reports) -> str:
         worst = max(worst, r.peak_bytes)
         bits = []
         for s in r.scans:
-            if s.provable:
+            if s.provable and s.partitions > 1:
+                bits.append(f"{s.table}: P={s.partitions} x "
+                            f"{_human(s.part_bytes)}/part "
+                            f"({s.part_rows:,} rows/part, k={s.fanout_k})")
+            elif s.provable:
                 bits.append(f"{s.table}: {_human(s.acc_bytes)} "
                             f"({s.acc_rows:,} rows, k={s.fanout_k})")
             else:

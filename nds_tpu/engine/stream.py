@@ -41,12 +41,36 @@ Survivor accumulators are sized from the statement's PROVEN row bound
 uniqueness + stream-fanout pair buckets), so a statement whose bound fits
 the ``NDS_TPU_HBM_BYTES`` capacity model can never trip the overflow
 rerun; unprovable or over-capacity bounds fall back to the legacy 2^23
-guess. Env knobs (all read at pipeline-BUILD time, never frozen at
+guess.
+
+PARTITIONED (grace-style) fan-out accumulation: a provable graph whose
+whole-statement bound exceeds the capacity model — the q17-class fan-out
+joins — is decomposed by join-key hash instead of falling back to the
+legacy clamp. A second tiny jitted pass assigns every live chunk row a
+partition id (multiplicative hash of the streamed slot's equi-join keys,
+``mem_audit.stream_partition_keys``) and keeps a device-resident
+partition histogram; the per-chunk join program gains the id vector and
+a traced partition-id operand, masking the chunk to one partition before
+the recorded graph runs (a lazy compact — same shapes, same replay log,
+so ONE compiled program serves every (chunk, partition) pair). Each
+partition accumulates into its OWN proof-sized accumulator
+(``mem_audit.partition_row_bound`` — skew-conditional, ENFORCED by a
+per-partition overflow flag), and the single materializing sync fetches
+every partition's count + flag + the histogram in one transfer, so the
+<=6-sync budget holds at any partition count. The partition count is
+chosen statically from the proof (``mem_audit.choose_partitions``) and
+joins the pipeline-cache key; partition count 1 is byte-for-byte
+today's unpartitioned pipeline.
+
+Env knobs (all read at pipeline-BUILD time, never frozen at
 import): ``NDS_TPU_STREAM_EXEC`` (compiled|eager),
 ``NDS_TPU_STREAM_ACC_ROWS`` (explicit hard accumulator ceiling / escape
-hatch; unset = proof-sized), ``NDS_TPU_STREAM_FANOUT`` (ops.py:
-stream-mode join pair-bucket allowance, default 4),
-``NDS_TPU_HBM_BYTES`` (capacity model, default 16 GiB).
+hatch, applied per partition; unset = proof-sized),
+``NDS_TPU_STREAM_FANOUT`` (ops.py: stream-mode join pair-bucket
+allowance, default 4), ``NDS_TPU_HBM_BYTES`` (capacity model, default
+16 GiB), ``NDS_TPU_STREAM_PARTITIONS`` (pin the partition count; unset =
+proof-chosen, <=1 disables), ``NDS_TPU_STREAM_SKEW`` (hash-skew safety
+factor of the per-partition bound, default 2).
 """
 
 from __future__ import annotations
@@ -83,26 +107,60 @@ def _acc_ceiling() -> int | None:
     return int(env) if env else None
 
 
-def _proved_row_bound(parts, keep, join_preds, where_conjuncts, sources,
-                      nrows):
-    """Statement-level survivor-row bound of the streamed graph, proven by
-    the static memory model (analysis/mem_audit.py): bucket(rows) x
-    fanout^k where k counts the join batches with no PK-unique side. None
-    when unprovable (subquery conjunct / unconnected graph — the trace
-    diverges there and the eager loop serves the query anyway)."""
+def _proved_plan(parts, keep, join_preds, where_conjuncts, sources, nrows):
+    """``(proved_rows, k, part_keys)`` of the streamed graph, from the
+    static memory model (analysis/mem_audit.py): the whole-statement
+    survivor bound ``bucket(rows) x fanout^k`` (k = join batches with no
+    PK-unique side), plus the chunk-side equi-key names a grace-style
+    partition pass may hash on. ``(None, None, None)`` when unprovable
+    (subquery conjunct / unconnected graph — the trace diverges there and
+    the eager loop serves the query anyway)."""
     try:
         from nds_tpu.analysis.mem_audit import (stream_graph_fanout,
+                                                stream_partition_keys,
                                                 structural_row_bound)
         part_cols = [{str(c).lower() for c in p.column_names}
                      for p in parts]
         srcs = [s.lower() if isinstance(s, str) else None for s in sources]
-        k = stream_graph_fanout(part_cols, srcs, keep,
-                                list(join_preds) + list(where_conjuncts))
+        conj = list(join_preds) + list(where_conjuncts)
+        k = stream_graph_fanout(part_cols, srcs, keep, conj)
         if k is None:
-            return None
-        return structural_row_bound(int(nrows), k, E.stream_fanout())
+            return None, None, None
+        return (structural_row_bound(int(nrows), k, E.stream_fanout()), k,
+                stream_partition_keys(part_cols, srcs, keep, conj))
     except Exception:                    # never let the proof break a query
-        return None
+        return None, None, None
+
+
+def _partition_plan(nrows, fan_k, part_keys, proved, row_bytes, n_chunks,
+                    chunk_out_plen):
+    """``(n_partitions, per_partition_row_bound)`` for the pipeline being
+    built: >1 only for a provable graph with chunk-side equi keys whose
+    whole bound is past capacity (or when NDS_TPU_STREAM_PARTITIONS pins
+    a count). Statically derived — it joins the pipeline-cache key via
+    the env knobs + table rows. The partition TRIGGER mirrors
+    mem_audit's rule shape: the accumulator the whole-graph proof would
+    size — ``min(chunk-sum, structural)``, clamped by the env ceiling —
+    is what gets compared against capacity (an explicit ceiling already
+    pins the allocation, so capacity pressure never forces a partition
+    pass under it)."""
+    if fan_k is None or not part_keys or proved is None:
+        return 1, None
+    try:
+        from nds_tpu.analysis.mem_audit import (choose_partitions,
+                                                stream_partitions_env)
+        forced = stream_partitions_env()
+        bound = min(n_chunks * chunk_out_plen, proved)
+        ceiling = _acc_ceiling()
+        if ceiling is not None:
+            bound = min(bound, ceiling)
+        need = bound * row_bytes > _hbm_bytes()
+        if not need and (forced is None or forced <= 1):
+            return 1, None
+        return choose_partitions(int(nrows), fan_k, E.stream_fanout(),
+                                 row_bytes, _hbm_bytes(), forced=forced)
+    except Exception:
+        return 1, None
 
 
 def _acc_row_budget(n_chunks, chunk_out_plen, proved, row_bytes):
@@ -120,6 +178,26 @@ def _acc_row_budget(n_chunks, chunk_out_plen, proved, row_bytes):
         return min(rows, ceiling)
     if proved is None or rows * row_bytes > _hbm_bytes():
         return min(rows, _DEFAULT_ACC_ROWS)
+    return rows
+
+
+def _part_acc_budget(n_chunks, chunk_out_plen, part_bound, row_bytes,
+                     n_parts):
+    """Per-partition accumulator rows. The per-partition proof admits the
+    bound by construction (choose_partitions), but every partition's
+    accumulator is live until the single materializing sync, so the
+    TOTAL allocation is additionally clamped to the capacity model —
+    past it, actual survivors beyond the clamp trip the per-partition
+    overflow flag and rerun eagerly (a perf fallback, never a
+    correctness one). The env ceiling stays a hard per-partition clamp."""
+    rows = n_chunks * chunk_out_plen
+    if part_bound is not None:
+        rows = min(rows, part_bound)
+    share = _hbm_bytes() // max(n_parts * row_bytes, 1)
+    rows = min(rows, max(share, chunk_out_plen))
+    ceiling = _acc_ceiling()
+    if ceiling is not None:
+        rows = min(rows, ceiling)
     return rows
 
 
@@ -194,11 +272,38 @@ def _chunk_signature(chunk: DeviceTable, alias: str):
     return tuple(spec)
 
 
+def _hash_mix(h, data):
+    """Fold one key column into the per-row partition hash (uint32).
+    Dictionary codes hash as their int32 codes (the whole-table encoding
+    makes them value-stable across chunks); floats hash their bit
+    pattern. Multiplicative mixing — any chunk-row partitioning keeps
+    the per-partition bound valid, the hash only evens the shares."""
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        data = jax.lax.bitcast_convert_type(
+            data, jnp.int64 if data.dtype.itemsize == 8 else jnp.int32)
+    x = data.astype(jnp.int64)
+    lo = (x & jnp.int64(0xffffffff)).astype(jnp.uint32)
+    hi = ((x >> 32) & jnp.int64(0xffffffff)).astype(jnp.uint32)
+    h = (h ^ lo) * jnp.uint32(2654435761)
+    h = h ^ (h >> 16)
+    h = (h ^ hi) * jnp.uint32(2246822519)
+    return h ^ (h >> 13)
+
+
 class StreamPipeline:
-    """One compiled per-chunk program plus the metadata to drive it."""
+    """One compiled per-chunk program plus the metadata to drive it.
+
+    ``n_partitions`` > 1 turns on grace-style partitioned accumulation:
+    ``key_slots`` index the chunk's flattened buffers that the partition
+    hash folds (the streamed slot's equi-join keys), the per-chunk
+    program takes the per-row partition ids plus a traced partition-id
+    scalar and masks the chunk before the recorded graph runs, and
+    ``run`` keeps one proof-sized accumulator per partition — all
+    fetched in the single materializing sync."""
 
     def __init__(self, chunk_spec, chunk_cap, part_specs, keep, log_entries,
-                 operands, out_template, acc_cap, part_refs):
+                 operands, out_template, acc_cap, part_refs,
+                 n_partitions=1, key_slots=()):
         self.chunk_spec = chunk_spec      # ((aliased name, kind, dict), ...)
         self.chunk_cap = chunk_cap
         self.part_specs = part_specs      # specs of non-streamed parts
@@ -212,7 +317,10 @@ class StreamPipeline:
         # collide after address reuse), and weakrefs don't pin dropped
         # tables' device memory for the cache entry's lifetime
         self.part_refs = part_refs
+        self.n_partitions = n_partitions
+        self.key_slots = tuple(key_slots)
         self.jitted = None
+        self._pid_jit = None
         # first jitted dispatch traces+compiles the per-chunk program;
         # the trace layer labels that dispatch "stream.compile"
         self.traced_once = False
@@ -227,8 +335,10 @@ class StreamPipeline:
         names, kinds, dicts, valided, dtypes = self.out_template
         acc_cap = self.acc_cap
         base_sources = list(sources)
+        n_partitions, key_slots = self.n_partitions, self.key_slots
 
-        def traced(chunk_flat, n_dev, parts_flat, ops_flat, acc):
+        def traced(chunk_flat, n_dev, parts_flat, ops_flat, acc,
+                   pids=None, part_id=None):
             acc_datas, acc_valids, acc_n, acc_ovf = acc
             cols, i = {}, 0
             for (aname, kind, dv) in chunk_spec:
@@ -237,6 +347,17 @@ class StreamPipeline:
                 i += 2
             chunk = DeviceTable(cols, E.DeviceCount(n_dev, chunk_cap),
                                 plen=chunk_cap)
+            if pids is not None:
+                # partition mask BEFORE the recorded graph: a lazy
+                # compact keeps the chunk's physical shape and bound
+                # (plen=chunk_cap), so the recorded host-read log stays
+                # valid for every (chunk, partition) pair. Under its own
+                # stream-bounds region: at production chunk sizes
+                # (chunk_cap > NDS_TPU_LAZY_SHRINK_ROWS) compact_table's
+                # adaptive resolve would otherwise host-sync on a tracer
+                # and silently divert the whole pipeline to eager
+                with E.stream_bounds():
+                    chunk = E.compact_table(chunk, pids == part_id)
             sub, pi = [], 0
             for j in range(len(part_specs) + 1):
                 if j == keep:
@@ -282,7 +403,27 @@ class StreamPipeline:
 
         # donate the accumulators: the pipeline's working set stays
         # (chunk in flight) + (chunk uploading) + ONE accumulator copy
+        # per partition (the partition mask routes each dispatch to its
+        # own accumulator, donated through)
         self.jitted = jax.jit(traced, donate_argnums=(4,))
+
+        if n_partitions > 1:
+            P = n_partitions
+
+            def pid_fn(chunk_flat, n_dev, hist):
+                h = jnp.full((chunk_cap,), 2166136261, dtype=jnp.uint32)
+                for s in key_slots:
+                    h = _hash_mix(h, chunk_flat[s])
+                pids = (h & jnp.uint32(P - 1)).astype(jnp.int32)
+                live = jnp.arange(chunk_cap) < n_dev
+                counts = jnp.bincount(jnp.where(live, pids, P),
+                                      length=P + 1)[:P]
+                return pids, hist + counts.astype(hist.dtype)
+
+            # the extra jitted partition pass: per-row partition ids +
+            # the device-resident input histogram (donated through) —
+            # no host syncs anywhere in it
+            self._pid_jit = jax.jit(pid_fn, donate_argnums=(2,))
         return self
 
     # ---------------------------------------------------------------- run
@@ -306,9 +447,13 @@ class StreamPipeline:
                 jnp.asarray(0, dtype=jnp.int64), jnp.asarray(False))
 
     def run(self, chunks, first_chunk, parts_flat):
-        """Drive every chunk through the compiled program; returns the
-        survivor DeviceTable or None on overflow (caller re-runs eagerly).
-        ``chunks`` continues AFTER ``first_chunk`` (already converted)."""
+        """Drive every chunk through the compiled program; returns
+        ``(survivor DeviceTable | None-on-overflow, n_chunks,
+        partition_evidence | None)`` (overflow => the caller re-runs
+        eagerly). ``chunks`` continues AFTER ``first_chunk`` (already
+        converted)."""
+        if self.n_partitions > 1:
+            return self._run_partitioned(chunks, first_chunk, parts_flat)
         acc = self.init_acc()
         cur = first_chunk
         n_chunks = 0
@@ -341,7 +486,11 @@ class StreamPipeline:
         with _obs.span("stream.materialize", chunks=n_chunks):
             total, overflowed = E.timed_read("stream_final", fetch)
         if overflowed:
-            return None, n_chunks
+            return None, n_chunks, None
+        return self._slice_acc(datas, valids, total), n_chunks, None
+
+    def _slice_acc(self, datas, valids, total):
+        """Survivor prefix of one accumulator as a DeviceTable."""
         names, kinds, dicts, valided, dtypes = self.out_template
         cap = E.bucket_len(total)
         cols = {}
@@ -350,8 +499,69 @@ class StreamPipeline:
                          valids[j] if valided[j] else None, dicts[j])
             cols[n] = slice_col_prefix(col, cap) if cap < self.acc_cap \
                 else col
-        return DeviceTable(cols, total, plen=min(cap, self.acc_cap)), \
-            n_chunks
+        return DeviceTable(cols, total, plen=min(cap, self.acc_cap))
+
+    def _run_partitioned(self, chunks, first_chunk, parts_flat):
+        """Grace-style drive: each chunk uploads ONCE, the partition pass
+        assigns row partition ids (histogram stays device-resident), and
+        the one compiled program dispatches once per partition into that
+        partition's own donated accumulator. Chunk-major order keeps the
+        double-buffered prefetch; partition-major survivor order is
+        row-order-independent downstream (joins/filters/aggregation
+        distribute over union). ONE materializing sync fetches every
+        partition's count + overflow flag + the input histogram."""
+        P = self.n_partitions
+        accs = [self.init_acc() for _ in range(P)]
+        hist = jnp.zeros(P, dtype=jnp.int64)
+        pid_consts = [jnp.asarray(p, dtype=jnp.int32) for p in range(P)]
+        cur = first_chunk
+        n_chunks = 0
+        while cur is not None:
+            n_dev = jnp.asarray(E.count_int(cur.nrows), dtype=jnp.int64)
+            flat = self._flatten_chunk(cur)
+            with _obs.span("stream.partition", chunk=n_chunks,
+                           partitions=P):
+                pids, hist = self._pid_jit(flat, n_dev, hist)
+            for p in range(P):
+                phase = "stream.drive" if self.traced_once \
+                    else "stream.compile"
+                with _obs.span(phase, chunk=n_chunks, part=p):
+                    accs[p] = self.jitted(flat, n_dev, parts_flat,
+                                          self.operands, accs[p],
+                                          pids=pids,
+                                          part_id=pid_consts[p])
+                self.traced_once = True
+            n_chunks += 1
+            with _obs.span("stream.prefetch", chunk=n_chunks):
+                cur = next(chunks, None)
+
+        def fetch():
+            got = jax.device_get([a[2] for a in accs]
+                                 + [a[3] for a in accs] + [hist])
+            return ([int(x) for x in got[:P]],
+                    [bool(x) for x in got[P:2 * P]],
+                    [int(x) for x in got[2 * P]])
+
+        # still THE one materializing sync: P counts + P flags + the
+        # histogram ride one transfer
+        with _obs.span("stream.materialize", chunks=n_chunks,
+                       partitions=P):
+            totals, overflowed, hist_host = E.timed_read("stream_final",
+                                                         fetch)
+        evidence = {"partitions": P, "part_rows": tuple(totals),
+                    "part_input": tuple(hist_host)}
+        if any(overflowed):
+            return None, n_chunks, evidence
+        tables = [self._slice_acc(accs[p][0], accs[p][1], totals[p])
+                  for p in range(P) if totals[p] > 0]
+        if not tables:                   # every partition empty
+            out = self._slice_acc(accs[0][0], accs[0][1], 0)
+        elif len(tables) == 1:
+            out = tables[0]
+        else:
+            # counts are host-known here, so the union costs no sync
+            out = E.concat_tables(tables)
+        return out, n_chunks, evidence
 
 
 def _weak(x):
@@ -371,7 +581,9 @@ def _dicts_equal(a, b) -> bool:
 
 
 def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
-               part_infos, chunk_spec, chunk_cap):
+               part_infos, chunk_spec, chunk_cap, stream_rows):
+    from nds_tpu.analysis.mem_audit import (stream_partitions_env,
+                                            stream_skew_factor)
     from nds_tpu.sql.parser import expr_key
     return (
         tuple(expr_key(c) for c in join_preds),
@@ -382,9 +594,12 @@ def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
                 spec[1], spec[2]))
               for (spec, _flat) in part_infos),
         # accumulator-sizing knobs: a pipeline built under a different
-        # ceiling/capacity/fanout must not be reused (its compiled acc
-        # shapes bake the old budget in)
+        # ceiling/capacity/fanout/partitioning must not be reused (its
+        # compiled acc shapes bake the old budget in), and the streamed
+        # table's row count feeds both the proof and the static
+        # partition count
         _acc_ceiling(), _hbm_bytes(), E.stream_fanout(),
+        stream_partitions_env(), stream_skew_factor(), int(stream_rows),
     )
 
 
@@ -454,7 +669,8 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
     key = None
     try:
         key = _cache_key(alias, keep, join_preds, where_conjuncts,
-                         masked_sources, part_infos, chunk_spec, chunk_cap)
+                         masked_sources, part_infos, chunk_spec, chunk_cap,
+                         chunked.nrows)
         pipe = _cache_hit(key, chunk_spec, part_infos)
     except Exception:
         pipe = None                      # unkeyable statement: no cache
@@ -478,7 +694,7 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
     checks_snapshot = [c for c, _f in
                        (getattr(E._sync_tls, "checks", None) or [])]
     try:
-        out, ran = pipe.run(chunk_iter, first, parts_flat)
+        out, ran, part_ev = pipe.run(chunk_iter, first, parts_flat)
         # tracing the first call replays planner code that registers
         # DeviceCounts/deferred checks holding TRACER values; they belong
         # to the trace, not this execution — drop them before any
@@ -495,14 +711,20 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
         log.info("streamed pipeline fell back to eager: %s", exc)
         return None, f"trace diverged: {exc}"
     if out is None:
-        # device-side overflow: rows were dropped, rerun eagerly. Keep the
-        # compiled program — other statements over smaller data may fit.
+        # device-side overflow (partitioned: some partition's enforced
+        # per-partition bucket): rows were dropped, rerun eagerly. Keep
+        # the compiled program — other statements over smaller data may
+        # fit.
         log.info("streamed pipeline overflowed its bound buckets; "
                  "re-running %s eagerly", alias)
         return None, "bound-bucket overflow"
+    part_ev = part_ev or {}
     record_stream_event(alias, ran, E.sync_count() - syncs0, "compiled",
-                        rows=int(out.nrows))
-    _obs.annotate(path="compiled", chunks=ran)
+                        rows=int(out.nrows),
+                        partitions=part_ev.get("partitions", 1),
+                        part_rows=part_ev.get("part_rows", ()))
+    _obs.annotate(path="compiled", chunks=ran,
+                  partitions=part_ev.get("partitions", 1))
     return out, None
 
 
@@ -552,16 +774,39 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
     row_bytes = sum(out0[n].data.dtype.itemsize
                     + (1 if out0[n].valid is not None else 0)
                     for n in names)
-    proved = _proved_row_bound(parts, keep, join_preds, where_conjuncts,
-                               masked_sources, parts[keep].chunked.nrows)
-    budget = _acc_row_budget(n_chunks, out0.plen, proved, max(row_bytes, 1))
+    stream_rows = parts[keep].chunked.nrows
+    proved, fan_k, part_keys = _proved_plan(parts, keep, join_preds,
+                                            where_conjuncts, masked_sources,
+                                            stream_rows)
+    n_parts, part_bound = _partition_plan(stream_rows, fan_k, part_keys,
+                                          proved, max(row_bytes, 1),
+                                          n_chunks, out0.plen)
+    key_slots = []
+    if n_parts > 1:
+        # map the partition keys (bare names) to the chunk's flattened
+        # buffer slots (2 slots per column: data, valid)
+        spec_names = [nm for (nm, _k, _dv) in chunk_spec]
+        for key in part_keys:
+            hit = [i for i, nm in enumerate(spec_names)
+                   if nm.split(".")[-1] == key]
+            if not hit:
+                n_parts, part_bound = 1, None    # key pruned off the scan
+                break
+            key_slots.append(2 * hit[0])
+    if n_parts > 1:
+        budget = _part_acc_budget(n_chunks, out0.plen, part_bound,
+                                  max(row_bytes, 1), n_parts)
+    else:
+        budget = _acc_row_budget(n_chunks, out0.plen, proved,
+                                 max(row_bytes, 1))
     acc_cap = E.bucket_len(max(budget, out0.plen))
-    _obs.annotate(accRows=acc_cap,
+    _obs.annotate(accRows=acc_cap, partitions=n_parts,
                   provedRows=proved if proved is not None else "unproven")
     lifted, operands = _lift_log(list(rec_log))
     pipe = StreamPipeline(
         chunk_spec, chunk_cap,
         tuple(spec for (spec, _flat) in part_infos), keep, lifted,
         tuple(operands), template, acc_cap,
-        [_weak(x) for (_spec, flat) in part_infos for x in flat])
+        [_weak(x) for (_spec, flat) in part_infos for x in flat],
+        n_partitions=n_parts, key_slots=key_slots)
     return pipe.compile(join_preds, where_conjuncts, masked_sources)

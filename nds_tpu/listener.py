@@ -116,13 +116,19 @@ class StreamEvent:
     #                            pipeline: the accumulator's final count —
     #                            the number tools/mem_audit_diff.py checks
     #                            against the static bound; -1 = unknown)
+    partitions: int = 1        # grace-style partition count of the
+    #                            compiled pipeline (1 = unpartitioned)
+    part_rows: tuple = ()      # per-partition survivor counts (partition
+    #                            order) — checked against the static
+    #                            per-partition bounds by mem_audit_diff
 
 
 _stream_tls = threading.local()
 
 
 def record_stream_event(where: str, chunks: int, syncs: int, path: str,
-                        reason: str = "", rows: int = -1) -> None:
+                        reason: str = "", rows: int = -1,
+                        partitions: int = 1, part_rows=()) -> None:
     """Engine-side hook (engine/stream.py, sql/planner.py): record how a
     streamed scan executed. Thread-scoped like the sync counters, so
     concurrent Throughput streams account their own pipelines."""
@@ -130,7 +136,8 @@ def record_stream_event(where: str, chunks: int, syncs: int, path: str,
     if lst is None:
         # deque(maxlen): diagnostics ring, never unbounded, O(1) evict
         lst = _stream_tls.events = deque(maxlen=1000)
-    lst.append(StreamEvent(where, chunks, syncs, path, reason, rows))
+    lst.append(StreamEvent(where, chunks, syncs, path, reason, rows,
+                           partitions, tuple(part_rows)))
 
 
 def drain_stream_events() -> list:
@@ -142,6 +149,21 @@ def drain_stream_events() -> list:
     out = list(lst)
     lst.clear()
     return out
+
+
+def stream_event_json(e: StreamEvent) -> dict:
+    """The ONE JSON shape of a StreamEvent in driver summaries
+    (power.py ``streamedScans`` / bench.py per-query results) — optional
+    fields appear only when meaningful, so existing consumers see no new
+    keys on unpartitioned scans."""
+    return {
+        "table": e.where, "chunks": e.chunks, "syncs": e.syncs,
+        "path": e.path,
+        **({"rows": e.rows} if e.rows >= 0 else {}),
+        **({"partitions": e.partitions, "partRows": list(e.part_rows)}
+           if e.partitions > 1 else {}),
+        **({"reason": e.reason} if e.reason else {}),
+    }
 
 
 def report_task_failure(where: str, exc: BaseException | str,

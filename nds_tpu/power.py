@@ -251,12 +251,9 @@ def run_query_stream(input_prefix: str,
         # that charged it
         stream_events = _drain_stream()
         if stream_events:
+            from nds_tpu.listener import stream_event_json
             q_report.summary["streamedScans"] = [
-                {"table": e.where, "chunks": e.chunks, "syncs": e.syncs,
-                 "path": e.path,
-                 **({"rows": e.rows} if e.rows >= 0 else {}),
-                 **({"reason": e.reason} if e.reason else {})}
-                for e in stream_events]
+                stream_event_json(e) for e in stream_events]
         # per-phase trace rollup (nds_tpu/obs): where the query's wall
         # went — plan, stream record/compile/drive, materialize — plus
         # the top sync-charging host-read sites; the full span tree goes

@@ -896,21 +896,29 @@ def mem_audit(sql, streamed=("store_sales",), **model_kw):
 
 def test_mem_audit_corpus_finite_and_deterministic():
     """Every template statement gets a finite positive byte bound, the
-    walk is deterministic, and the only capacity findings are the 7
-    baselined fan-out accumulators (query17/24x2/25/29/64/72)."""
+    walk is deterministic, and the partition decomposition clears EVERY
+    capacity finding: the 7 former fan-out accumulators
+    (query17/24x2/25/29/64/72) are now proven per partition, each
+    per-partition bound inside the capacity model."""
     from nds_tpu.analysis.mem_audit import (audit_mem_corpus,
+                                            hbm_capacity_bytes,
                                             reports_to_findings)
     reports = audit_mem_corpus()
     assert len(reports) >= 99
     for r in reports:
         assert r.mode in ("streamed", "device"), (r.query, r.detail)
         assert r.peak_bytes > 0 and r.out_rows >= 0
-    fs = reports_to_findings(reports)
-    assert all(f.rule == "hbm-capacity" and f.severity == "error"
-               for f in fs)
-    assert sorted({f.file for f in fs}) == \
-        ["query17.tpl", "query24.tpl", "query25.tpl", "query29.tpl",
-         "query64.tpl", "query72.tpl"]
+    assert reports_to_findings(reports) == []
+    partitioned = {r.query: s for r in reports for s in r.scans
+                   if s.partitions > 1}
+    assert sorted(partitioned) == \
+        ["query17", "query24_part1", "query24_part2", "query25",
+         "query29", "query64", "query72"]
+    cap = hbm_capacity_bytes()
+    for q, s in partitioned.items():
+        assert s.provable and s.part_bytes <= cap, (q, s)
+        assert s.part_rows * s.partitions >= s.acc_rows, \
+            (q, "partition shares must cover the whole bound")
     again = audit_mem_corpus()
     assert [r.to_dict() for r in again] == [r.to_dict() for r in reports]
 
@@ -989,6 +997,76 @@ def test_mem_audit_capacity_gate():
     fs = reports_to_findings([r], capacity_bytes=1 << 10)
     assert [f.rule for f in fs] == ["hbm-capacity"]
     assert "device-resident" in fs[0].message
+
+
+def test_mem_audit_partition_rules(monkeypatch):
+    """The grace-style partition proof: choose_partitions picks the
+    smallest power-of-two count whose skew-factored per-partition bound
+    fits capacity, NDS_TPU_STREAM_PARTITIONS pins it, scans with no
+    chunk-side equi key never partition, and the hbm-capacity gate moves
+    to the per-partition bound for partitioned scans."""
+    from nds_tpu.analysis.mem_audit import (choose_partitions,
+                                            partition_row_bound,
+                                            reports_to_findings,
+                                            stream_partition_keys,
+                                            structural_row_bound)
+    rows, k, fanout = 28_900_000, 1, 4
+    whole = structural_row_bound(rows, k, fanout)
+    # auto: whole bound fits -> unpartitioned
+    assert choose_partitions(rows, k, fanout, 150,
+                             whole * 150 + 1) == (1, None)
+    # auto: over capacity -> smallest admitting power of two
+    p, bound = choose_partitions(rows, k, fanout, 150, 16 << 30)
+    assert p == 4 and bound == partition_row_bound(rows, 4, k, fanout)
+    assert bound * 150 <= 16 << 30
+    assert partition_row_bound(rows, 2, k, fanout) >= bound
+    # the skew-factored shares always cover the whole bound
+    assert bound * p >= whole // 2
+    # forced count wins, rounded up to a power of two
+    assert choose_partitions(rows, k, fanout, 150, 16 << 30,
+                             forced=3)[0] == 4
+    assert choose_partitions(rows, k, fanout, 150, 16 << 30,
+                             forced=1) == (1, None)
+    # nothing admits -> (1, None): the runtime keeps the legacy clamp
+    assert choose_partitions(rows, k, fanout, 150, 1 << 10) == (1, None)
+
+    # partition keys: the fan-out batch's chunk-side keys win over a
+    # PK-covered batch; a bare scan (no equi edge) has none
+    from nds_tpu.sql.parser import parse
+    from nds_tpu.analysis.exec_audit import _conjuncts_of
+    sel = parse("""select 1 from store_sales, date_dim, store_returns
+                   where ss_sold_date_sk = d_date_sk
+                     and ss_item_sk = sr_item_sk""").body
+    part_cols = [{"store_sales.ss_sold_date_sk", "store_sales.ss_item_sk"},
+                 {"date_dim.d_date_sk"},
+                 {"store_returns.sr_item_sk",
+                  "store_returns.sr_ticket_number"}]
+    sources = ["store_sales", "date_dim", "store_returns"]
+    keys = stream_partition_keys(part_cols, sources, 0,
+                                 _conjuncts_of(sel.where))
+    assert keys == ("ss_item_sk",)       # the k=1 batch, not the PK one
+    assert stream_partition_keys(part_cols[:1], sources[:1], 0, []) is None
+
+    # gate rule: a partitioned scan whose PER-PARTITION bound fits is
+    # clean even though the whole-scan bound is past capacity...
+    r = mem_audit("""select ss_item_sk, sr_return_amt
+                     from store_sales, store_returns
+                     where ss_item_sk = sr_item_sk""",
+                  capacity_bytes=1 << 30)
+    (s,) = r.scans
+    assert s.partitions > 1 and s.acc_bytes > (1 << 30)
+    assert s.part_bytes <= (1 << 30)
+    assert not reports_to_findings([r], capacity_bytes=1 << 30)
+    # ...and a forced under-partitioned count that cannot fit IS a
+    # finding, named per partition
+    monkeypatch.setenv("NDS_TPU_STREAM_PARTITIONS", "2")
+    r = mem_audit("""select ss_item_sk, sr_return_amt
+                     from store_sales, store_returns
+                     where ss_item_sk = sr_item_sk""",
+                  capacity_bytes=1 << 30)
+    fs = reports_to_findings([r], capacity_bytes=1 << 30)
+    assert [f.rule for f in fs] == ["hbm-capacity"]
+    assert "per-partition" in fs[0].message
 
 
 def test_mem_audit_scoped_star_pruning():
@@ -1117,13 +1195,12 @@ def test_lint_cli_format_json(tmp_path):
     for e in entries:
         assert set(e) == {"rule", "file", "symbol", "severity", "count",
                           "baselined"}
-    # the shipped tree is fully baselined: the q77 cartesian plus the 7
-    # accepted hbm-capacity accumulator bounds (fan-out joins whose
-    # enforced pair-bucket bound exceeds the 16 GiB capacity model — the
-    # worklist for partitioned/spilling accumulation), nothing new
+    # the shipped tree is fully baselined: exactly q77's spec-deliberate
+    # cartesian (partitioned accumulation cleared the 7 former
+    # hbm-capacity fan-out findings), nothing new
     assert doc["new"] == 0
     assert [(e["rule"], e["baselined"]) for e in entries] == \
-        [("cartesian-join", True)] + [("hbm-capacity", True)] * 7
+        [("cartesian-join", True)]
     # a failing corpus keeps stdout pure JSON and still exits 2
     seeded = tmp_path / "templates"
     shutil.copytree(TEMPLATES, seeded)

@@ -194,6 +194,64 @@ def test_setup_timeout_circuit_breaker(monkeypatch, capsys):
     assert "failing fast" in out.err
 
 
+def test_external_timeout_flushes_partial_geomean(tmp_path, monkeypatch,
+                                                  capsys):
+    """An external `timeout` kill (rc=124) mid-campaign must still record
+    the partial geomean of every COMPLETED query — PERF.md + metric line
+    — not BENCH_r05's {"value": null, "n_queries": 0}. Simulated: the
+    child serves query1, then the SIGTERM handler fires while query2 is
+    in flight."""
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "ensure_data", lambda: None)
+    monkeypatch.setattr(bench, "bench_queries",
+                        lambda: [("query1", "select 1"),
+                                 ("query2", "select 2")])
+    monkeypatch.setattr(bench, "_emitted", False)
+
+    handlers = {}
+    monkeypatch.setattr(bench.signal, "signal",
+                        lambda signum, fn: handlers.setdefault(signum, fn))
+
+    def fake_exit(code):
+        raise SystemExit(code)
+
+    monkeypatch.setattr(bench.os, "_exit", fake_exit)
+
+    class OneQueryChild:
+        def __init__(self):
+            self.proc = None
+            self.started = False
+
+        def alive(self):
+            return self.started
+
+        def start(self, deadline_left):
+            self.started = True
+            return {"ready": True, "platform": "axon"}
+
+        def run_query(self, name, timeout):
+            if name == "query1":
+                return {"name": "query1", "ms": 123.0, "hostSyncs": 1,
+                        "syncWaitMs": 2.0}
+            # query2 in flight when the external timeout lands
+            handlers[bench.signal.SIGTERM](bench.signal.SIGTERM, None)
+            raise AssertionError("handler must not return")
+
+        def stop(self):
+            pass
+
+    monkeypatch.setattr(bench, "ChildServer", OneQueryChild)
+    import time as _time
+    with pytest.raises(SystemExit):
+        bench.run_parent(_time.perf_counter())
+    out = capsys.readouterr()
+    msg = json.loads(out.out.strip().splitlines()[-1])
+    assert msg["n_queries"] == 1
+    assert msg["value"] == pytest.approx(123.0)
+    perf_text = open(tmp_path / "PERF.md").read()
+    assert "query1" in perf_text and "platform: axon." in perf_text
+
+
 def test_write_perf_stamps_platform_and_streamed(tmp_path, monkeypatch):
     """PERF.md header carries the measured jax platform (provenance) and
     the streamed->HBM scan path aggregate when any query streamed."""

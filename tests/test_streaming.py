@@ -211,6 +211,141 @@ def test_acc_ceiling_env_read_at_build_time(monkeypatch, tmp_path):
     assert events[0].rows == 5000        # survivor count on the event
 
 
+def _return_tables(n=5000, n_keys=79):
+    """sales (streamed) + a returns side whose join key covers no PK —
+    the fan-out (k=1) shape partitioned accumulation exists for.
+    ``n_keys`` caps the sales key cardinality: 1 = every row carries one
+    key (the whole table hashes to ONE partition: the skew case); a few
+    keys under a large partition count guarantees EMPTY partitions."""
+    rng = np.random.default_rng(7)
+    keys = rng.integers(1, n_keys + 1, n)
+    sales = pa.table({
+        "s_item": pa.array(keys, pa.int64()),
+        "s_qty": pa.array(rng.integers(1, 50, n), pa.int64()),
+    })
+    returns = pa.table({
+        "r_item": pa.array(np.repeat(np.arange(1, 81), 2), pa.int64()),
+        "r_amt": pa.array(rng.integers(1, 100, 160), pa.int64()),
+    })
+    return sales, returns
+
+
+_PART_SQL = ("select s_item, count(*) c, sum(r_amt) a from sales, returns "
+             "where s_item = r_item group by s_item order by s_item")
+
+
+def _run_partition_case(monkeypatch, sales, returns, partitions,
+                        chunk_rows=800, acc_rows=None):
+    from nds_tpu.listener import drain_stream_events
+    resident = Session()
+    resident.create_temp_view("sales", sales, base=True)
+    resident.create_temp_view("returns", returns, base=True)
+    expect = resident.sql(_PART_SQL).collect()
+    if partitions is not None:
+        monkeypatch.setenv("NDS_TPU_STREAM_PARTITIONS", str(partitions))
+    if acc_rows is not None:
+        monkeypatch.setenv("NDS_TPU_STREAM_ACC_ROWS", str(acc_rows))
+    s = Session()
+    s.create_temp_view("sales", ChunkedTable(sales, chunk_rows=chunk_rows),
+                       base=True)
+    s.create_temp_view("returns", returns, base=True)
+    drain_stream_events()
+    got = s.sql(_PART_SQL).collect()
+    events = drain_stream_events()
+    assert got == expect, "partitioned result diverged from resident"
+    return events
+
+
+def test_partitioned_pipeline_empty_partitions(monkeypatch, tmp_path):
+    """Partition count far above the key cardinality (4 keys over 32
+    partitions) GUARANTEES empty partitions: the pipeline must stay
+    compiled, report a zero survivor count for each empty partition, and
+    the per-partition survivors must sum to the scan total — results
+    exact either way. The partition passes must emit zero-sync
+    stream.partition spans that tools/trace_report.py prices as their
+    own phase column."""
+    import importlib.util
+    import os as _os
+
+    from nds_tpu.obs import export as obs_export
+    from nds_tpu.obs import trace as obs_trace
+
+    obs_trace.drain_spans()
+    sales, returns = _return_tables(n=2000, n_keys=4)
+    events = _run_partition_case(monkeypatch, sales, returns, 32)
+    assert [e.path for e in events] == ["compiled"]
+    (e,) = events
+    assert e.partitions == 32 and len(e.part_rows) == 32
+    assert sum(e.part_rows) == e.rows
+    assert 0 in e.part_rows, "4 keys over 32 partitions must leave gaps"
+    records = obs_trace.drain_spans()
+    part_spans = [r for r in records
+                  if isinstance(r, obs_trace.SpanRecord)
+                  and r.name == "stream.partition"]
+    assert len(part_spans) == 3          # one partition pass per chunk
+    assert all(s.syncs == 0 for s in part_spans), \
+        "the radix partition pass must never charge a host sync"
+    tdir = tmp_path / "traces"
+    tdir.mkdir()
+    obs_export.write_chrome_trace(str(tdir / "q.trace.json"), records,
+                                  query="q")
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", _os.path.join(repo, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = "\n".join(mod.report(str(tdir)))
+    assert "stream.partition" in out, \
+        "trace_report must price partition passes as their own column"
+
+
+def test_partitioned_pipeline_hot_partition_overflow_rerun(monkeypatch):
+    """Skewed keys: every row carries ONE join key, so the hash routes
+    the whole table into a single partition. With a per-partition
+    accumulator ceiling below that partition's survivors, the enforced
+    per-partition overflow flag must fire and the query must rerun
+    eagerly — bit-identical results, path='eager', the overflow reason
+    on the event (the skew-conditional proof is a perf property, never
+    a correctness one)."""
+    sales, returns = _return_tables(n_keys=1)
+    events = _run_partition_case(monkeypatch, sales, returns, 4,
+                                 acc_rows=2048)
+    assert [e.path for e in events] == ["eager"]
+    assert events[0].reason == "bound-bucket overflow"
+
+
+def test_partitioned_pipeline_survives_adaptive_resolve(monkeypatch):
+    """Regression: at production chunk sizes (chunk_cap past the
+    NDS_TPU_LAZY_SHRINK_ROWS threshold) the partition mask's lazy
+    compact must NOT take compact_table's adaptive host resolve inside
+    the traced program — that would raise on the tracer and silently
+    divert every partitioned pipeline to the eager loop. Simulated by
+    lowering the threshold below the toy chunk capacity."""
+    from nds_tpu.engine import ops as E
+    monkeypatch.setattr(E, "_LAZY_SHRINK_ROWS", 256)
+    sales, returns = _return_tables()
+    events = _run_partition_case(monkeypatch, sales, returns, 4,
+                                 chunk_rows=800)    # chunk_cap 1024 > 256
+    assert [e.path for e in events] == ["compiled"], \
+        "partition compact took the adaptive resolve inside the trace"
+    assert events[0].partitions == 4
+
+
+def test_partition_count_one_is_unpartitioned(monkeypatch):
+    """NDS_TPU_STREAM_PARTITIONS=1 must run bit-for-bit identical to
+    today's unpartitioned pipeline: same compiled path, partition count
+    1 on the event, no per-partition evidence, same rows."""
+    sales, returns = _return_tables()
+    base = _run_partition_case(monkeypatch, sales, returns, None)
+    monkeypatch.delenv("NDS_TPU_STREAM_PARTITIONS", raising=False)
+    forced1 = _run_partition_case(monkeypatch, sales, returns, 1)
+    for events in (base, forced1):
+        assert [e.path for e in events] == ["compiled"]
+        (e,) = events
+        assert e.partitions == 1 and e.part_rows == ()
+    assert base[0].rows == forced1[0].rows
+
+
 def test_session_stream_threshold(monkeypatch, tmp_path):
     """read_columnar_view streams tables past the byte threshold."""
     import pyarrow.parquet as pq

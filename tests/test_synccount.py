@@ -9,6 +9,8 @@ the engine's per-query budget so a regression back to per-operator syncs
 fails loudly, and verify the lazy/batched machinery is exact.
 """
 
+import contextlib
+
 import numpy as np
 import pyarrow as pa
 import pytest
@@ -194,7 +196,12 @@ def test_outer_join_sync_budget(rng):
 
 def _chunked_star_session(rng, chunk_rows=2048):
     """star_session's tables with store_sales bound as a >HBM-style
-    ChunkedTable (tiny chunk_rows forces a many-chunk pipeline)."""
+    ChunkedTable (tiny chunk_rows forces a many-chunk pipeline), plus a
+    store_returns dimension whose join key does NOT cover its declared
+    primary key (sr_item_sk, sr_ticket_number) — the fan-out (k=1) join
+    shape the partitioned-accumulation templates exercise. 3 rows per
+    item keeps the per-chunk pair bucket inside the stream-fanout
+    allowance (default 4), so the fan-out joins stay compiled."""
     from nds_tpu.engine.table import ChunkedTable
     n_fact, n_dim = 20_000, 365
     s = Session()
@@ -206,6 +213,11 @@ def _chunked_star_session(rng, chunk_rows=2048):
     s.create_temp_view("item", pa.table({
         "i_item_sk": pa.array(np.arange(1, 201), pa.int64()),
         "i_brand_id": pa.array(rng.integers(1000, 1020, 200), pa.int64()),
+    }), base=True)
+    s.create_temp_view("store_returns", pa.table({
+        "sr_item_sk": pa.array(np.repeat(np.arange(1, 201), 3), pa.int64()),
+        "sr_ticket_number": pa.array(np.arange(600), pa.int64()),
+        "sr_return_amt": pa.array(rng.integers(1, 100, 600), pa.int64()),
     }), base=True)
     s.create_temp_view("store_sales", ChunkedTable(pa.table({
         "ss_sold_date_sk": pa.array(
@@ -254,7 +266,55 @@ _STREAM_AB_QUERIES = [
     # bare grouped aggregate, no WHERE
     ("""select ss_sold_date_sk, count(*) c from store_sales
         group by ss_sold_date_sk order by ss_sold_date_sk""", True),
+    # --- partitioned fan-out joins (grace-style accumulation). The
+    # ss->sr edge covers only part of store_returns' composite PK, so
+    # k=1: the shape whose SF10 accumulator bound forced partitioning
+    # (q17/q25/q29-class). The A/B harnesses run the whole set under
+    # NDS_TPU_STREAM_PARTITIONS=2, which drives these through the
+    # partitioned pipeline — bit-for-bit equal to eager, still one
+    # materializing sync.
+    ("""select ss_item_sk, count(*) c, sum(sr_return_amt) r
+        from store_sales, store_returns
+        where ss_item_sk = sr_item_sk and ss_ext_sales_price > 5000
+        group by ss_item_sk order by ss_item_sk""", True),
+    # fan-out + PK dimension in one graph (partition key rides the
+    # fan-out batch; the item gather stays whole on every partition)
+    ("""select i_brand_id, sum(sr_return_amt) r, count(*) c
+        from store_sales, store_returns, item
+        where ss_item_sk = sr_item_sk and ss_item_sk = i_item_sk
+          and sr_return_amt > 50
+        group by i_brand_id order by i_brand_id""", True),
 ]
+
+# indexes of the fan-out templates above: under a forced partition count
+# these must stream through the PARTITIONED compiled pipeline (the A/B
+# harnesses and test_streamed_compiled_matches_eager assert it)
+_STREAM_AB_PARTITIONED = tuple(
+    i for i, (q, _must) in enumerate(_STREAM_AB_QUERIES)
+    if "store_returns" in q)
+
+# the partition count every A/B partitioned sweep forces (the toy
+# session's bounds all fit 16 GiB, so auto mode would never partition)
+_STREAM_AB_PARTITION_COUNT = 2
+
+
+@contextlib.contextmanager
+def _forced_stream_partitions(n=_STREAM_AB_PARTITION_COUNT):
+    """Pin NDS_TPU_STREAM_PARTITIONS for one A/B sweep — the ONE
+    save/set/restore shared by test_streamed_compiled_matches_eager and
+    both differential harnesses (tools/exec_audit_diff.py,
+    tools/mem_audit_diff.py), so the forced count can never drift
+    between the fixtures and their checkers."""
+    import os
+    old = os.environ.get("NDS_TPU_STREAM_PARTITIONS")
+    os.environ["NDS_TPU_STREAM_PARTITIONS"] = str(n)
+    try:
+        yield n
+    finally:
+        if old is None:
+            del os.environ["NDS_TPU_STREAM_PARTITIONS"]
+        else:
+            os.environ["NDS_TPU_STREAM_PARTITIONS"] = old
 
 
 def test_streamed_chunked_sync_budget(rng):
@@ -288,20 +348,35 @@ def test_streamed_chunked_sync_budget(rng):
 def test_streamed_compiled_matches_eager():
     """A/B correctness: every template must produce bit-identical rows
     through the compiled chunk pipeline and through the eager chunk loop
-    (NDS_TPU_STREAM_EXEC=eager escape hatch). Both arms rebuild their
-    session from the same fresh seed (the shared rng fixture is
+    (NDS_TPU_STREAM_EXEC=eager escape hatch). The compiled arm runs under
+    NDS_TPU_STREAM_PARTITIONS=2 so the fan-out templates
+    (_STREAM_AB_PARTITIONED) take the grace-style PARTITIONED pipeline —
+    per-partition survivor counts must sum to the scan total and the
+    whole set must stay within the <=6-sync budget. Both arms rebuild
+    their session from the same fresh seed (the shared rng fixture is
     session-scoped: its stream position depends on test order)."""
     import os
     from nds_tpu.listener import drain_stream_events
     compiled_rows, eager_rows = [], []
-    s = _chunked_star_session(np.random.default_rng(42))
-    drain_stream_events()
-    for q, must_stream in _STREAM_AB_QUERIES:
-        compiled_rows.append(s.sql(q).collect())
-        paths = [e.path for e in drain_stream_events()]
-        if must_stream:
-            assert paths == ["compiled"], \
-                f"compiled arm fell back ({paths}) on: {q}"
+    with _forced_stream_partitions() as n_parts:
+        s = _chunked_star_session(np.random.default_rng(42))
+        drain_stream_events()
+        for i, (q, must_stream) in enumerate(_STREAM_AB_QUERIES):
+            before = _syncs()
+            compiled_rows.append(s.sql(q).collect())
+            used = _syncs() - before
+            events = drain_stream_events()
+            paths = [e.path for e in events]
+            if must_stream:
+                assert paths == ["compiled"], \
+                    f"compiled arm fell back ({paths}) on: {q}"
+                assert used <= 6, \
+                    f"streamed template used {used} syncs (budget 6): {q}"
+            if i in _STREAM_AB_PARTITIONED:
+                (e,) = events
+                assert e.partitions == n_parts, (q, e)
+                assert len(e.part_rows) == n_parts
+                assert sum(e.part_rows) == e.rows
     old = os.environ.get("NDS_TPU_STREAM_EXEC")
     os.environ["NDS_TPU_STREAM_EXEC"] = "eager"
     try:

@@ -22,7 +22,14 @@ disagrees with what actually ran:
   drained span's sync delta must EQUAL its StreamEvent's ``syncs`` on
   every sight — if the trace layer ever started paying for its own
   metrics (or drifted off the event window), span > event and this
-  harness fails before the budget tests would.
+  harness fails before the budget tests would;
+* **partition pass** — the whole A/B set executes under
+  ``NDS_TPU_STREAM_PARTITIONS=2``, so the fan-out templates
+  (``_STREAM_AB_PARTITIONED``) must take the grace-style PARTITIONED
+  compiled pipeline (StreamEvent ``partitions`` > 1), every drained
+  ``stream.partition`` span must carry a ZERO sync delta (the radix pass
+  is device-only by construction), and the sync/budget checks above hold
+  unchanged — the partition pass is sync-free, so no bound moves.
 
 ``--inject-drift`` flips every predicted path before comparing — a model-
 drift fixture that MUST fail, proving the harness can catch a stale model
@@ -44,57 +51,77 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def _load_ab_templates():
-    """The canonical A/B statements + the chunked toy session builder, from
-    tests/test_synccount.py — importing the pinned definitions keeps the
-    harness and the tier-1 budget tests on the same fixtures by
-    construction."""
+def _load_ab_module():
     path = os.path.join(REPO, "tests", "test_synccount.py")
     spec = importlib.util.spec_from_file_location("_synccount_fixtures",
                                                   path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_ab_templates():
+    """The canonical A/B statements + the chunked toy session builder, from
+    tests/test_synccount.py — importing the pinned definitions keeps the
+    harness and the tier-1 budget tests on the same fixtures by
+    construction."""
+    mod = _load_ab_module()
     return mod._STREAM_AB_QUERIES, mod._chunked_star_session
 
 
 def collect_runtime_evidence():
     """Execute each A/B template twice (cold: record+compile; warm:
-    pipeline-cache hit) and return per-template evidence dicts."""
+    pipeline-cache hit) under NDS_TPU_STREAM_PARTITIONS=2 and return
+    per-template evidence dicts."""
     import numpy as np
 
     from nds_tpu.engine import ops as E
     from nds_tpu.listener import drain_stream_events
     from nds_tpu.obs import trace as obs_trace
 
-    queries, make_session = _load_ab_templates()
-    session = make_session(np.random.default_rng(42))
-    drain_stream_events()
-    traced = obs_trace.on()
-    obs_trace.drain_spans()
-    evidence = []
-    for sql, _must_stream in queries:
-        runs = []
-        for sight in ("cold", "warm"):
-            before = E.sync_count()
-            rows = session.sql(sql).collect()
-            used = E.sync_count() - before
-            events = drain_stream_events()
-            # per-scan spans from the trace layer, execution order: each
-            # must carry the same sync delta its StreamEvent recorded
-            spans = [r for r in obs_trace.drain_spans()
-                     if getattr(r, "name", "") == "stream"
-                     and r.attrs.get("path")]
-            runs.append({
-                "sight": sight, "syncs": used,
-                "paths": [e.path for e in events],
-                "reasons": [e.reason for e in events if e.reason],
-                "event_syncs": [e.syncs for e in events],
-                "span_paths": [s.attrs.get("path") for s in spans],
-                "span_syncs": [s.syncs for s in spans],
-                "rows": len(rows),
-            })
-        evidence.append({"sql": sql, "cold": runs[0], "warm": runs[1],
-                         "traced": traced})
+    mod = _load_ab_module()
+    queries = mod._STREAM_AB_QUERIES
+    partitioned = set(getattr(mod, "_STREAM_AB_PARTITIONED", ()))
+    # forced partition count: the ONE context manager the fixture module
+    # ships, so the fixtures and every checker force the same count
+    with mod._forced_stream_partitions():
+        session = mod._chunked_star_session(np.random.default_rng(42))
+        drain_stream_events()
+        traced = obs_trace.on()
+        obs_trace.drain_spans()
+        evidence = []
+        for i, (sql, _must_stream) in enumerate(queries):
+            runs = []
+            for sight in ("cold", "warm"):
+                before = E.sync_count()
+                rows = session.sql(sql).collect()
+                used = E.sync_count() - before
+                events = drain_stream_events()
+                records = obs_trace.drain_spans()
+                # per-scan spans from the trace layer, execution order:
+                # each must carry the same sync delta its StreamEvent
+                # recorded
+                spans = [r for r in records
+                         if getattr(r, "name", "") == "stream"
+                         and r.attrs.get("path")]
+                part_spans = [r for r in records
+                              if getattr(r, "name", "")
+                              == "stream.partition"]
+                runs.append({
+                    "sight": sight, "syncs": used,
+                    "paths": [e.path for e in events],
+                    "reasons": [e.reason for e in events if e.reason],
+                    "event_syncs": [e.syncs for e in events],
+                    "partitions": [e.partitions for e in events],
+                    "span_paths": [s.attrs.get("path") for s in spans],
+                    "span_syncs": [s.syncs for s in spans],
+                    "part_span_count": len(part_spans),
+                    "part_span_syncs": sum(s.syncs for s in part_spans),
+                    "rows": len(rows),
+                })
+            evidence.append({"sql": sql, "cold": runs[0], "warm": runs[1],
+                             "traced": traced,
+                             "must_partition": i in partitioned})
     return evidence
 
 
@@ -183,6 +210,29 @@ def compare(reports, evidence, inject_drift=False):
                         problems.append(
                             f"{sight} runtime reason {rt_reason!r} is not "
                             f"explained by static codes {rep.reasons}")
+        # partitioned pipeline (the sweep forces NDS_TPU_STREAM_PARTITIONS):
+        # the fan-out templates must have taken the grace-style path, and
+        # the radix partition pass must be SYNC-FREE — a stream.partition
+        # span with a nonzero sync delta means the partition pass started
+        # paying host round trips the static model prices at zero
+        if ev.get("must_partition") and not inject_drift:
+            for sight in ("cold", "warm"):
+                r = ev[sight]
+                if not r["partitions"] or \
+                        any(p <= 1 for p in r["partitions"]):
+                    problems.append(
+                        f"{sight} expected the partitioned pipeline "
+                        f"(forced count), got partitions {r['partitions']}")
+                if not r["part_span_count"]:
+                    problems.append(
+                        f"{sight} partitioned run drained no "
+                        "stream.partition spans")
+        for sight in ("cold", "warm"):
+            if ev[sight].get("part_span_syncs"):
+                problems.append(
+                    f"{sight} stream.partition spans charged "
+                    f"{ev[sight]['part_span_syncs']} host syncs; the "
+                    "partition pass must be device-only (0)")
         # trace-layer parity (independent of the drift injection: it is
         # runtime-vs-runtime): every streamed scan's span must report the
         # exact syncs its StreamEvent charged — zero-added-sync tracing,

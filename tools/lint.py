@@ -80,9 +80,14 @@ def git_changed_files():
 
 
 # a change under any of these invalidates the corpus-level audits (the
-# analyzers mirror planner/engine semantics — the lockstep rule)
+# analyzers mirror planner/engine semantics — the lockstep rule).
+# listener.py is included because StreamEvent is the runtime evidence
+# schema the differential harnesses check the audits against — the
+# partition code paths (engine/stream.py, analysis/mem_audit.py,
+# listener StreamEvent fields) all rerun the corpus passes on change.
 _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
-                 "nds_tpu/engine", "nds_tpu/schema.py")
+                 "nds_tpu/engine", "nds_tpu/schema.py",
+                 "nds_tpu/listener.py")
 
 
 def run_passes(template_dir=None, changed=None, want_reports=False):

@@ -19,6 +19,13 @@ the checked contract, mirroring ``tools/exec_audit_diff.py``:
   - a compiled streamed scan's measured survivor count
     (``StreamEvent.rows``, the accumulator's final total) must be
     <= the scan's proven accumulator row bound;
+  - the whole sweep runs under ``NDS_TPU_STREAM_PARTITIONS=2``, so the
+    fan-out templates take the grace-style PARTITIONED pipeline: the
+    runtime partition count must equal the model's static choice, and
+    EVERY per-partition survivor count (``StreamEvent.part_rows``) must
+    fit the proven per-partition bound
+    (``mem_audit.partition_row_bound`` — the skew-conditional bound the
+    per-partition overflow flag enforces);
   - a statement's materialized output row count must be <= the
     statement's ``out_rows`` bound (joins bounded by schema key
     uniqueness, group-bys by key domains — the rules DESIGN.md's
@@ -28,13 +35,15 @@ the checked contract, mirroring ``tools/exec_audit_diff.py``:
     (a provable bound that the executor rejects means the model and
     ``stream_graph_fanout`` drifted apart).
 
-``--inject-drift`` zeroes every predicted bound before comparing — a
-model-drift fixture that MUST fail, proving the harness can catch an
-under-bounding model (``tests/test_analysis.py`` asserts both
-directions). Run it after any change to the planner's join bounds,
-``ChunkedTable`` chunk shapes, ``engine/stream.py`` accumulator sizing,
-or the schema widths: the static model and the executor are kept in
-lockstep the same way ``exec_audit`` tracks the stream routing.
+``--inject-drift`` zeroes every predicted bound — the per-partition
+bounds INCLUDED — before comparing: a model-drift fixture that MUST
+fail in both the whole-scan and the partition direction, proving the
+harness can catch an under-bounding model (``tests/test_analysis.py``
+asserts both directions). Run it after any change to the planner's join
+bounds, ``ChunkedTable`` chunk shapes, ``engine/stream.py`` accumulator
+sizing or partition plan, or the schema widths: the static model and
+the executor are kept in lockstep the same way ``exec_audit`` tracks
+the stream routing.
 """
 
 import argparse
@@ -48,15 +57,20 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def _load_ab_templates():
-    """The canonical A/B statements + the chunked toy session builder,
-    imported by path from tests/test_synccount.py so the harness and the
-    tier-1 budget tests share one set of fixtures by construction."""
+def _load_ab_module():
     path = os.path.join(REPO, "tests", "test_synccount.py")
     spec = importlib.util.spec_from_file_location("_synccount_fixtures",
                                                   path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def _load_ab_templates():
+    """The canonical A/B statements + the chunked toy session builder,
+    imported by path from tests/test_synccount.py so the harness and the
+    tier-1 budget tests share one set of fixtures by construction."""
+    mod = _load_ab_module()
     return mod._STREAM_AB_QUERIES, mod._chunked_star_session
 
 
@@ -71,39 +85,51 @@ def _session_row_bounds(session) -> dict:
 
 
 def predict(queries, row_bounds):
-    from nds_tpu.analysis.mem_audit import MemAuditor, MemModel
-    model = MemModel(row_bounds=row_bounds)
-    auditor = MemAuditor(streamed={"store_sales"}, model=model)
-    return [auditor.audit_sql(sql, query=f"ab{i + 1}")
-            for i, (sql, _must) in enumerate(queries)]
+    # predictions run under the SAME forced partition count as the
+    # evidence sweep (MemModel reads the env at construction, so the
+    # static partition choice and the runtime's agree by construction)
+    with _load_ab_module()._forced_stream_partitions():
+        from nds_tpu.analysis.mem_audit import MemAuditor, MemModel
+        model = MemModel(row_bounds=row_bounds)
+        auditor = MemAuditor(streamed={"store_sales"}, model=model)
+        return [auditor.audit_sql(sql, query=f"ab{i + 1}")
+                for i, (sql, _must) in enumerate(queries)]
 
 
 def collect_runtime_evidence():
     """Execute each A/B template twice (cold: record+compile; warm:
-    pipeline-cache hit) and return per-template evidence plus the toy
-    session's row bounds."""
+    pipeline-cache hit) under the forced partition count and return
+    per-template evidence plus the toy session's row bounds."""
     import numpy as np
 
     from nds_tpu.listener import drain_stream_events
 
-    queries, make_session = _load_ab_templates()
-    session = make_session(np.random.default_rng(42))
-    bounds = _session_row_bounds(session)
-    drain_stream_events()
+    mod = _load_ab_module()
+    queries = mod._STREAM_AB_QUERIES
+    partitioned = set(getattr(mod, "_STREAM_AB_PARTITIONED", ()))
     evidence = []
-    for sql, _must in queries:
-        runs = []
-        for sight in ("cold", "warm"):
-            rows = session.sql(sql).collect()
-            events = drain_stream_events()
-            runs.append({
-                "sight": sight,
-                "out_rows": len(rows),
-                "paths": [e.path for e in events],
-                "survivors": [e.rows for e in events
-                              if e.path == "compiled" and e.rows >= 0],
-            })
-        evidence.append({"sql": sql, "cold": runs[0], "warm": runs[1]})
+    with mod._forced_stream_partitions():
+        session = mod._chunked_star_session(np.random.default_rng(42))
+        bounds = _session_row_bounds(session)
+        drain_stream_events()
+        for i, (sql, _must) in enumerate(queries):
+            runs = []
+            for sight in ("cold", "warm"):
+                rows = session.sql(sql).collect()
+                events = drain_stream_events()
+                runs.append({
+                    "sight": sight,
+                    "out_rows": len(rows),
+                    "paths": [e.path for e in events],
+                    "survivors": [e.rows for e in events
+                                  if e.path == "compiled" and e.rows >= 0],
+                    "partitions": [e.partitions for e in events
+                                   if e.path == "compiled"],
+                    "part_rows": [list(e.part_rows) for e in events
+                                  if e.path == "compiled"],
+                })
+            evidence.append({"sql": sql, "cold": runs[0], "warm": runs[1],
+                             "must_partition": i in partitioned})
     return evidence, bounds
 
 
@@ -114,10 +140,14 @@ def compare(reports, evidence, inject_drift=False):
     ok = True
     lines = []
     for rep, ev in zip(reports, evidence):
-        acc_bounds = [s.acc_rows for s in rep.scans if s.provable]
+        provable = [s for s in rep.scans if s.provable]
+        acc_bounds = [s.acc_rows for s in provable]
+        part_preds = [(s.partitions, s.part_rows) for s in provable]
         out_bound = rep.out_rows
         if inject_drift:
             acc_bounds = [0 for _ in acc_bounds]
+            part_preds = [(p, 0 if pr is not None else None)
+                          for (p, pr) in part_preds]
             out_bound = 0
         head = (f"[{rep.query}] mode={rep.mode} "
                 f"peak={rep.peak_bytes:,}B out<={out_bound:,}")
@@ -126,6 +156,12 @@ def compare(reports, evidence, inject_drift=False):
             problems.append(f"no finite bound: {rep.detail}")
         if rep.peak_bytes <= 0:
             problems.append("peak bound is not positive")
+        if ev.get("must_partition") and not inject_drift and \
+                not any(p > 1 for (p, _pr) in part_preds):
+            problems.append(
+                "fan-out template: the model chose no partition "
+                "decomposition under the forced partition count "
+                "(model drift)")
         for sight in ("cold", "warm"):
             r = ev[sight]
             if r["out_rows"] > max(out_bound, 0):
@@ -154,6 +190,30 @@ def compare(reports, evidence, inject_drift=False):
                         f"{sight} accumulator kept {got} survivor rows > "
                         f"static bound {bound} (UNSOUND: the proof-sized "
                         "accumulator would have dropped rows)")
+            # partitioned runs: static partition count must match the
+            # runtime's (both derive from the same forced env + shared
+            # choose_partitions), and every per-partition survivor count
+            # must fit the proven per-partition bound — the allocation
+            # unit the per-partition overflow flag enforces
+            for i, got_p in enumerate(r.get("partitions", [])):
+                pred_p, pred_rows = part_preds[i] \
+                    if i < len(part_preds) else (None, None)
+                if pred_p is None:
+                    continue             # already reported as model drift
+                if not inject_drift and got_p != pred_p:
+                    problems.append(
+                        f"{sight} compiled scan #{i} ran {got_p} "
+                        f"partitions, the model chose {pred_p} "
+                        "(partition plan drift)")
+                if got_p > 1 and pred_rows is not None:
+                    for j, n in enumerate(r["part_rows"][i]):
+                        if n > pred_rows:
+                            problems.append(
+                                f"{sight} partition {j} kept {n} "
+                                f"survivor rows > per-partition bound "
+                                f"{pred_rows} (UNSOUND: the proof-sized "
+                                "partition accumulator would have "
+                                "dropped rows)")
         if not ev["warm"]["out_rows"]:
             problems.append("A/B template unexpectedly returned no rows")
         if problems:
@@ -162,10 +222,12 @@ def compare(reports, evidence, inject_drift=False):
             lines.extend(f"    {p}" for p in problems)
         else:
             survivors = ev["warm"]["survivors"]
+            parts = [p for p in ev["warm"].get("partitions", []) if p > 1]
+            extra = f", partitions {parts}" if parts else ""
             lines.append(
                 f"ok {head} :: warm survivors {survivors} <= "
-                f"{acc_bounds} acc bound, {ev['warm']['out_rows']} rows "
-                f"out via {ev['warm']['paths']}")
+                f"{acc_bounds} acc bound{extra}, {ev['warm']['out_rows']} "
+                f"rows out via {ev['warm']['paths']}")
     return ok, lines
 
 

@@ -27,14 +27,17 @@ from collections import Counter, defaultdict
 
 # phase columns of the breakdown table, in pipeline order; everything
 # else (query/stream umbrellas, uncovered wall) folds into "other".
-# stream.overflow-rerun is the eager re-execution after a completed
-# compiled run overflowed its bound buckets — its cost is priced
-# separately in the fallback ranking (the wasted pipeline time is the
-# stream span's remainder).
+# stream.partition is the grace-style radix pass of a partitioned
+# pipeline (per-chunk partition-id hashing + device-resident histogram)
+# — priced as its own column so a partitioned statement's partition
+# overhead is visible next to compile/drive. stream.overflow-rerun is
+# the eager re-execution after a completed compiled run overflowed its
+# bound buckets — its cost is priced separately in the fallback ranking
+# (the wasted pipeline time is the stream span's remainder).
 PHASES = ("plan", "replay.record", "replay.compile", "replay.drive",
-          "stream.record", "stream.compile", "stream.prefetch",
-          "stream.drive", "stream.eager", "stream.overflow-rerun",
-          "stream.materialize", "materialize")
+          "stream.record", "stream.compile", "stream.partition",
+          "stream.prefetch", "stream.drive", "stream.eager",
+          "stream.overflow-rerun", "stream.materialize", "materialize")
 
 
 def self_times(events):
