@@ -14,21 +14,28 @@ with no device in the loop, answers for every template:
    applies per :func:`nds_tpu.engine.replay.record_eligible`) — with
    machine-readable reason codes mirroring the executor's real routing:
 
-   * ``subquery-residual`` — a conjunct of the streamed join graph carries
-     a subquery. The chunk-invariant program is traced with an EMPTY
-     catalog (a cached pipeline must not pin device state), so the residual
-     cannot resolve its tables and the trace diverges
-     (``stream_execute`` → "trace diverged: unknown table ...").
+   * ``subquery-residual`` — RETIRED from the shipped corpus by
+     multi-pass streaming: subquery conjuncts pre-plan their inner
+     queries into device-resident residuals that ride the per-chunk
+     program as ordinary jit operands (scans tagged
+     ``streamed-subquery``; NOT IN's null probe additionally
+     ``recorded-scalar``). The code survives for foreign corpora whose
+     shapes the residual machinery cannot serve.
    * ``chunk-dependent-host-read`` — the streamed graph has unconnected
      components: ``Planner._cartesian`` lays out the pair expansion from
      host row counts, and ``DeviceCount.to_int`` inside a stream-bounds
      region raises ``StreamSyncError`` (observed runtime reason:
      "not chunk-invariant").
    * ``outer-join-extras`` — the chunked scan sits on a side of an outer
-     join with no selective structure in its streamed subgraph: outer
-     extras semantics need the whole side materialized, so the survivor
-     accumulator holds the entire >HBM scan and overflows by construction
-     (overflow ⇒ eager rerun).
+     join the multi-pass deferral cannot serve (ON keys not covering the
+     probe side's PK, post-join WHERE over an outer-build side): outer
+     extras semantics then need the whole side materialized, so the
+     survivor accumulator holds the entire >HBM scan and overflows by
+     construction (overflow ⇒ eager rerun). Eligible LEFT joins instead
+     DEFER into the streamed graph (``outer-gather`` — per-chunk PK
+     gather on the preserved side; ``outer-build`` — inner pairs plus an
+     on-device unmatched-key accumulator, extras at materialize) and
+     classify compiled.
    * ``accumulator-overflow`` — same mechanism without the outer-join
      context: a bare streamed scan (no filter, no join) keeps every chunk
      row AND the static memory model (:mod:`nds_tpu.analysis.mem_audit`)
@@ -123,9 +130,10 @@ SYNC_BUDGET = 6
 # from arrow.nbytes, which the audit cannot see — this set is the static
 # stand-in and is parameterizable per ExecAuditor).
 DEFAULT_STREAMED = ("catalog_sales", "inventory", "store_sales", "web_sales")
-# (round 9 corpus: 74 compiled-stream / 22 eager-fallback / 7
-# device-resident — the memory proof retired every provable
-# accumulator-overflow fallback)
+# (round 11 corpus: 96 compiled-stream / 7 device-resident / 0
+# eager-fallback — multi-pass streaming retired the subquery-residual
+# and outer-join-extras fallbacks; the counts are pinned in tier-1 by
+# tests/test_analysis.py::test_stream_report_classification_counts_pinned)
 
 # descending resident-size rank of the streamable facts: when a graph binds
 # several chunked scans the planner streams the LARGEST (by nbytes) and
@@ -158,6 +166,9 @@ class ScanVerdict:
     gate_bound: int = 0        # steady-state local sync bound (gated <= 6)
     per_chunk: int = 0         # eager loop: syncs charged PER CHUNK
     first_sight: int = 0       # one-time record/compile extras (not gated)
+    mechanisms: tuple = ()     # multi-pass conversions serving this scan
+    #                            ("streamed-subquery", "outer-gather",
+    #                             "outer-build", "recorded-scalar")
 
 
 @dataclass
@@ -185,7 +196,9 @@ class ExecReport:
                        "compiled": s.compiled, "reasons": list(s.reasons),
                        "gate_bound": s.gate_bound,
                        "per_chunk": s.per_chunk,
-                       "first_sight": s.first_sight} for s in self.scans],
+                       "first_sight": s.first_sight,
+                       "mechanisms": list(s.mechanisms)}
+                      for s in self.scans],
             "detail": self.detail,
         }
 
@@ -196,7 +209,8 @@ class _Rel:
     materialized outer join keeps BOTH sides' aliases addressable, exactly
     like the planner's alias-qualified merged columns."""
 
-    __slots__ = ("cols", "classes", "source", "chunked", "single_row")
+    __slots__ = ("cols", "classes", "source", "chunked", "single_row",
+                 "outer_mech")
 
     def __init__(self, alias, columns, classes=None, source=None,
                  chunked=False, single_row=False):
@@ -205,6 +219,10 @@ class _Rel:
         self.source = source          # pristine base-table name, else None
         self.chunked = chunked
         self.single_row = single_row
+        # multi-pass streaming marker: "outer-gather" (deferred probe) /
+        # "outer-build" (unmatched-key accumulator) when this rel entered
+        # the graph through a deferred LEFT join
+        self.outer_mech = None
 
     @property
     def alias(self) -> str:
@@ -265,6 +283,19 @@ def _has_subquery(e) -> bool:
                       A.QuantifiedCompare)):
         return True
     return any(_has_subquery(c) for c in _children(e))
+
+
+def _subquery_nodes(e) -> list:
+    """Top-level subquery nodes of one expression (no descent into a
+    found subquery's own body): the residuals the streamed pipeline
+    pre-plans for this conjunct, one resolve each."""
+    if isinstance(e, (A.ScalarSubquery, A.InSubquery, A.Exists,
+                      A.QuantifiedCompare)):
+        return [e]
+    out = []
+    for c in _children(e):
+        out.extend(_subquery_nodes(c))
+    return out
 
 
 def _column_refs(e):
@@ -502,7 +533,7 @@ class ExecAuditor:
     # -- FROM flattening (mirror of Planner._flatten_from) ------------------
 
     def _flatten_from(self, node, env: dict, outer, where: list,
-                      cost: _Cost, local_scans: list):
+                      cost: _Cost, local_scans: list, top: bool = True):
         if node is None:
             return [], []
         if isinstance(node, A.TableRef):
@@ -521,46 +552,156 @@ class ExecAuditor:
         if isinstance(node, A.Join):
             if node.kind in ("cross", "inner"):
                 lp, lj = self._flatten_from(node.left, env, outer, where,
-                                            cost, local_scans)
+                                            cost, local_scans, top=False)
                 rp, rj = self._flatten_from(node.right, env, outer, where,
-                                            cost, local_scans)
+                                            cost, local_scans, top=False)
                 return lp + rp, lj + rj + _conjuncts_of(node.condition)
             # outer/semi/anti join: each side is its own join graph,
             # materialized whole before the join — WHERE conjuncts owned
-            # by the null-preserving side push below it first
+            # by the null-preserving side push below it first. A LEFT
+            # join with a chunked side may instead DEFER into the
+            # streamed graph (multi-pass mechanisms b1/b2, mirroring
+            # Planner._flatten_from): the sides' rels then join the
+            # enclosing graph with the ON conjuncts as ordinary edges.
             lp, lj = self._flatten_from(node.left, env, outer, where,
-                                        cost, local_scans)
+                                        cost, local_scans, top=False)
+            deferred = self._deferred_left(node, lp, lj, env, outer,
+                                           where, cost, local_scans, top)
+            if deferred is not None:
+                return deferred
             lw = self._consume_pushable(where, lp) \
                 if node.kind == "left" else []
             self._audit_graph(lp, lj, lw, (lp, env, outer), cost,
                               local_scans, outer_ctx=True)
             rp, rj = self._flatten_from(node.right, env, outer, where,
-                                        cost, local_scans)
-            rw = self._consume_pushable(where, rp) \
-                if node.kind == "right" else []
-            self._audit_graph(rp, rj, rw, (rp, env, outer), cost,
-                              local_scans, outer_ctx=True)
-            join_cost = self._binary_join_cost(node, lp, rp, cost)
-            # every streamed scan flattened so far in this SELECT feeds (or
-            # conservatively precedes) this materialized join: its result
-            # rides through the join's syncs on the way to the output
-            for s in local_scans:
-                if s.compiled:
-                    s.gate_bound += join_cost
-            sides = lp + rp
-            if not sides:
-                return [], []
-            merged = sides[0]
-            for p in sides[1:]:
-                merged = merged.merged_with(p)
-            merged.single_row = False
-            merged.chunked = False
-            merged.source = None
-            return [merged], []
+                                        cost, local_scans, top=False)
+            return self._finish_outer(node, lp, rp, rj, env, outer, where,
+                                      cost, local_scans)
         if isinstance(node, A.Query):        # parenthesized join tree
             return self._flatten_from(getattr(node.body, "from_", None),
                                       env, outer, where, cost, local_scans)
         return [], []
+
+    def _finish_outer(self, node, lp, rp, rj, env, outer, where, cost,
+                      local_scans):
+        """The materialize-both-sides completion of one outer/semi/anti
+        join (the right side already flattened; the left side already
+        audited)."""
+        rw = self._consume_pushable(where, rp) \
+            if node.kind == "right" else []
+        self._audit_graph(rp, rj, rw, (rp, env, outer), cost,
+                          local_scans, outer_ctx=True)
+        join_cost = self._binary_join_cost(node, lp, rp, cost)
+        # every streamed scan flattened so far in this SELECT feeds (or
+        # conservatively precedes) this materialized join: its result
+        # rides through the join's syncs on the way to the output
+        for s in local_scans:
+            if s.compiled:
+                s.gate_bound += join_cost
+        sides = lp + rp
+        if not sides:
+            return [], []
+        merged = sides[0]
+        for p in sides[1:]:
+            merged = merged.merged_with(p)
+        merged.single_row = False
+        merged.chunked = False
+        merged.source = None
+        return [merged], []
+
+    def _deferred_left(self, node, lp, lj, env, outer, where, cost,
+                       local_scans, top):
+        """Mirror of the planner's multi-pass LEFT-join deferral
+        (``Planner._flatten_from`` mechanisms b1/b2): returns the merged
+        ``(parts, preds)`` when the join defers into the streamed graph,
+        the completed materialize-path result when a side had to be
+        flattened to decide (no double audit), or None when the
+        pre-checks already exclude deferral (caller runs today's path).
+        ``top`` mirrors the planner's whole-FROM requirement for the
+        outer-build deferral."""
+        if node.kind != "left" or node.condition is None:
+            return None
+        conjs = _conjuncts_of(node.condition)
+        if not conjs or any(_has_subquery(c) for c in conjs):
+            return None
+
+        def plain_pairs(rel):
+            """(left key, right key) bare names per conjunct when every
+            conjunct is a plain cross-side equi pair against ``rel``."""
+            out = []
+            for c in conjs:
+                if not (isinstance(c, A.BinaryOp) and c.op == "=" and
+                        isinstance(c.left, A.ColumnRef) and
+                        isinstance(c.right, A.ColumnRef)):
+                    return None
+                rk = rel.owns(c.left)
+                lk_ref = c.right
+                if rk is None:
+                    rk = rel.owns(c.right)
+                    lk_ref = c.left
+                if rk is None:
+                    return None
+                if not any(p.owns(lk_ref) for p in lp):
+                    return None
+                out.append((lk_ref, rk))
+            return out
+
+        l_chunk = any(p.chunked for p in lp)
+        if l_chunk:
+            if os.environ.get("NDS_TPU_NO_PK_GATHER"):
+                return None              # the b1 gather arm is disabled
+            # mechanism (b1): preserved chunk side — right must be one
+            # pristine scan whose ON keys are exactly its (composite) PK
+            rp, rj = self._flatten_from(node.right, env, outer, where,
+                                        cost, local_scans, top=False)
+            eligible = len(rp) == 1 and not rj and rp[0].source and \
+                not rp[0].chunked
+            if eligible:
+                pairs = plain_pairs(rp[0])
+                pk = COMPOSITE_PRIMARY_KEYS.get(rp[0].source)
+                if pk is None and rp[0].source in PRIMARY_KEYS:
+                    pk = (PRIMARY_KEYS[rp[0].source],)
+                eligible = pairs is not None and pk is not None and \
+                    {rk for (_lr, rk) in pairs} == set(pk)
+                if eligible and len(pk) > 1 and any(
+                        rp[0].classes.get(k) != "num" for k in pk):
+                    eligible = False     # composite pack is int-only
+            if eligible:
+                rp[0].outer_mech = "outer-gather"
+                return lp + rp, lj + conjs
+            # ineligible after flattening: the planner's materialize
+            # path, reusing the flattened right side
+            lw = self._consume_pushable(where, lp)
+            self._audit_graph(lp, lj, lw, (lp, env, outer), cost,
+                              local_scans, outer_ctx=True)
+            return self._finish_outer(node, lp, rp, rj, env, outer,
+                                      where, cost, local_scans)
+        # mechanism (b2): null-introducing chunk side — single device
+        # part on the left (the build side, materialized first with its
+        # pushed WHERE conjuncts), single chunked scan on the right, the
+        # join being the SELECT's whole FROM, and no remaining WHERE
+        # conjunct at all (post-join structure would need the extras,
+        # emitted only at materialize, to flow through it)
+        if len(lp) != 1 or lp[0].chunked:
+            return None
+        lw = self._consume_pushable(where, lp)
+        rp, rj = self._flatten_from(node.right, env, outer, where, cost,
+                                    local_scans, top=False)
+        eligible = top and len(rp) == 1 and not rj and rp[0].chunked \
+            and not (where or [])
+        if eligible:
+            pairs = plain_pairs(rp[0])
+            eligible = pairs is not None
+        if eligible:
+            lp[0].outer_mech = "outer-build"
+            lp[0].single_row = False
+            return rp + lp, lj + conjs
+        # fall back: audit the build side as its own (device) graph and
+        # finish with the materialize path
+        self._audit_graph(lp, lj, lw, (lp, env, outer), cost,
+                          local_scans, outer_ctx=True)
+        return self._finish_outer(node, lp, rp, rj, env, outer, where,
+                                  cost, local_scans)
 
     def _binary_join_cost(self, node: A.Join, lp, rp, cost: _Cost) -> int:
         """Sync charge of one materialized (outer/semi/anti) binary join.
@@ -769,8 +910,23 @@ class ExecAuditor:
         keep = max(chunked_idx,
                    key=lambda i: (_SIZE_RANK.get(parts[i].source, 0), -i))
         reasons = []
+        mechanisms = []
         if subq:
-            reasons.append(R_SUBQUERY)
+            # multi-pass streaming, mechanism (a): subquery conjuncts
+            # pre-plan their inner tables into device-resident RESIDUALS
+            # (recorded/driven as ordinary jit operands), so they no
+            # longer break the chunk-invariant trace — the conjunct
+            # reduces to a device-side membership/compare mask per chunk
+            mechanisms.append("streamed-subquery")
+            if any(isinstance(nq, A.InSubquery) and nq.negated
+                   for c in subq for nq in _subquery_nodes(c)):
+                # ANSI NOT IN consults the residual's null count: a
+                # recorded scalar with a device-side staleness guard
+                # (mechanism c)
+                mechanisms.append("recorded-scalar")
+        for p in parts:
+            if p.outer_mech and p.outer_mech not in mechanisms:
+                mechanisms.append(p.outer_mech)
         if ncomp > 1:
             reasons.append(R_CHUNK_READ)
         incident = any(keep in (li, ri) for (li, ri, _c) in edges) or \
@@ -791,13 +947,18 @@ class ExecAuditor:
         verdicts = []
         if compiled:
             # pipeline steady state: ONE materializing sync (count +
-            # overflow flag); the upfront part-count resolve batches
-            # counts the statement owed anyway. Record-phase dimension
-            # plan reads ride the replay log: first-sight only.
+            # overflow flag + outer-extras counts in the same transfer);
+            # the upfront part-count resolve batches counts the statement
+            # owed anyway. Record-phase dimension plan reads ride the
+            # replay log: first-sight only. Each subquery residual is
+            # re-planned per execution — its table resolves once (the
+            # inner plan's own costs are subq_cost).
+            n_resid = sum(len(_subquery_nodes(c)) for c in subq)
             v = ScanVerdict(parts[keep].alias, parts[keep].source or "?",
                             True, (), gate_bound=1,
-                            first_sight=len(pk_dims) + 1)
-            cost.fixed += 1 + subq_cost.fixed
+                            first_sight=len(pk_dims) + 1,
+                            mechanisms=tuple(mechanisms))
+            cost.fixed += 1 + subq_cost.fixed + n_resid
             cost.first_sight += v.first_sight + subq_cost.first_sight
         else:
             # eager chunk loop: every chunk re-plans the graph — each
@@ -809,7 +970,8 @@ class ExecAuditor:
                 subq_cost.fixed + subq_cost.per_chunk
             v = ScanVerdict(parts[keep].alias, parts[keep].source or "?",
                             False, tuple(reasons), per_chunk=per_chunk,
-                            first_sight=len(pk_dims))
+                            first_sight=len(pk_dims),
+                            mechanisms=tuple(mechanisms))
             cost.fixed += 1
             cost.per_chunk += per_chunk
             cost.first_sight += len(pk_dims) + subq_cost.first_sight
@@ -823,7 +985,8 @@ class ExecAuditor:
                 w = ScanVerdict(parts[i].alias, parts[i].source or "?",
                                 compiled, v.reasons,
                                 gate_bound=v.gate_bound,
-                                per_chunk=v.per_chunk)
+                                per_chunk=v.per_chunk,
+                                mechanisms=v.mechanisms)
                 cost.scans.append(w)
                 local_scans.append(w)
                 verdicts.append(w)
@@ -944,7 +1107,10 @@ def format_stream_report(reports) -> str:
         bits = []
         for s in r.scans:
             if s.compiled:
-                bits.append(f"{s.table}: compiled gate={s.gate_bound}"
+                mech = f" [{','.join(s.mechanisms)}]" if s.mechanisms \
+                    else ""
+                bits.append(f"{s.table}: compiled{mech} "
+                            f"gate={s.gate_bound}"
                             f"(+{s.first_sight} first-sight)")
             else:
                 bits.append(f"{s.table}: eager [{','.join(s.reasons)}] "

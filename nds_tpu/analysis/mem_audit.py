@@ -285,15 +285,18 @@ def stream_graph_fanout(part_cols, sources, keep, conjuncts):
     join batches with no unique (PK-covered) side is clamped at runtime
     by the stream-bounds pair bucket (probe bucket × fanout, device
     overflow flag past it), and every unique batch keeps per-row
-    multiplicity at <= 1. Returns None when a conjunct carries a subquery
-    (the trace diverges — the executor falls back eager) or when some
-    part is not connected to the streamed slot by equi edges (cartesian
-    layout: a chunk-data-dependent host read, same fallback)."""
+    multiplicity at <= 1. Subquery conjuncts are FILTERS: multi-pass
+    streaming pre-plans their inner tables into device residuals and the
+    conjunct reduces to a membership/compare mask over joined rows —
+    never growing them — so they do not affect the bound. Returns None
+    when some part is not connected to the streamed slot by equi edges
+    (cartesian layout: a chunk-data-dependent host read, eager
+    fallback)."""
     n = len(part_cols)
     batches: dict = {}
     for c in conjuncts:
         if _has_subquery(c):
-            return None
+            continue
         e = _equi_sides(c, part_cols)
         if e is None:
             # single-part filter, correlation, or a cross-part non-equi
@@ -428,7 +431,8 @@ def stream_partition_keys(part_cols, sources, keep, conjuncts):
     """Bare chunk-side column names the partition hash keys on, or None
     when the streamed graph is not partitionable (no plain-column equi
     edge incident to the streamed slot — bare scans, expression-only
-    edges, subquery conjuncts).
+    edges; subquery conjuncts are skipped like in
+    :func:`stream_graph_fanout`, they are residual-planned filters).
 
     Prefers a fan-out batch (no PK-unique side — the batch whose
     multiplicity forced partitioning in the first place) so rows that
@@ -439,7 +443,7 @@ def stream_partition_keys(part_cols, sources, keep, conjuncts):
     batches: dict = {}
     for c in conjuncts:
         if _has_subquery(c):
-            return None
+            continue
         e = _equi_sides(c, part_cols)
         if e is None:
             continue
@@ -948,7 +952,7 @@ class MemAuditor:
     def _audit_select(self, sel: A.Select, env: dict,
                       cost: _MemCost) -> _MRel:
         where = _conjuncts_of(sel.where)
-        parts, preds = self._flatten_from(sel.from_, env, cost)
+        parts, preds = self._flatten_from(sel.from_, env, cost, where)
         if parts:
             joined = self._audit_graph(parts, list(preds) + list(where),
                                        env, cost)
@@ -1027,7 +1031,8 @@ class MemAuditor:
 
     # -- FROM flattening (mirror of Planner._flatten_from) ------------------
 
-    def _flatten_from(self, node, env: dict, cost: _MemCost):
+    def _flatten_from(self, node, env: dict, cost: _MemCost, where=None,
+                      top: bool = True):
         if node is None:
             return [], []
         if isinstance(node, A.TableRef):
@@ -1051,22 +1056,106 @@ class MemAuditor:
             return [rel], []
         if isinstance(node, A.Join):
             if node.kind in ("cross", "inner"):
-                lp, lj = self._flatten_from(node.left, env, cost)
-                rp, rj = self._flatten_from(node.right, env, cost)
+                lp, lj = self._flatten_from(node.left, env, cost, where,
+                                            top=False)
+                rp, rj = self._flatten_from(node.right, env, cost, where,
+                                            top=False)
                 return lp + rp, lj + rj + _conjuncts_of(node.condition)
+            lp, lj = self._flatten_from(node.left, env, cost, top=False)
+            got = self._deferred_left(node, lp, lj, env, cost, where, top)
+            if got is not None:
+                return got
             # outer/semi/anti join: each side materializes whole first
-            lp, lj = self._flatten_from(node.left, env, cost)
             left = self._audit_graph(lp, lj, env, cost)
             rp, rj = self._flatten_from(node.right, env, cost)
-            right = self._audit_graph(rp, rj, env, cost)
-            rows = self._binary_join_rows(node, left, right)
-            merged = left.merged_with(right, rows)
-            cost.peak += _bucket(max(rows, 1)) * merged.width
-            return [merged], []
+            return self._finish_outer(node, left, rp, rj, env, cost)
         if isinstance(node, A.Query):        # parenthesized join tree
             return self._flatten_from(getattr(node.body, "from_", None),
-                                      env, cost)
+                                      env, cost, where)
         return [], []
+
+    def _finish_outer(self, node, left, rp, rj, env, cost):
+        right = self._audit_graph(rp, rj, env, cost)
+        rows = self._binary_join_rows(node, left, right)
+        merged = left.merged_with(right, rows)
+        cost.peak += _bucket(max(rows, 1)) * merged.width
+        return [merged], []
+
+    def _deferred_left(self, node, lp, lj, env, cost, where, top=True):
+        """Mirror of the planner's multi-pass LEFT-join deferral (and of
+        ``exec_audit._deferred_left``): an eligible join's sides flow
+        into the enclosing streamed graph with the ON conjuncts as plain
+        edges — the bound rules (PK-unique side => multiplicity 1) then
+        price the join exactly like an inner PK batch, and the outer
+        extras stay bounded by the preserved side's rows (every preserved
+        row appears exactly once, matched or null-extended)."""
+        if node.kind != "left" or node.condition is None:
+            return None
+        conjs = _conjuncts_of(node.condition)
+        if not conjs or any(_has_subquery(c) for c in conjs):
+            return None
+
+        def plain_pairs(rel):
+            out = []
+            for c in conjs:
+                if not (isinstance(c, A.BinaryOp) and c.op == "=" and
+                        isinstance(c.left, A.ColumnRef) and
+                        isinstance(c.right, A.ColumnRef)):
+                    return None
+                rk = rel.owns(c.left)
+                lref = c.right
+                if rk is None:
+                    rk = rel.owns(c.right)
+                    lref = c.left
+                if rk is None or not any(p.owns(lref) for p in lp):
+                    return None
+                out.append((lref, rk))
+            return out
+
+        l_chunk = any(p.chunked for p in lp)
+        if l_chunk:
+            if os.environ.get("NDS_TPU_NO_PK_GATHER"):
+                return None              # the b1 gather arm is disabled
+            # (b1): preserved chunk side — one pristine right scan whose
+            # ON keys are exactly its declared (composite) primary key
+            rp, rj = self._flatten_from(node.right, env, cost, top=False)
+            eligible = len(rp) == 1 and not rj and rp[0].source and \
+                not rp[0].chunked
+            if eligible:
+                pairs = plain_pairs(rp[0])
+                pk = _table_pk(rp[0].source)
+                eligible = pairs is not None and pk is not None and \
+                    {rk for (_l, rk) in pairs} == set(pk)
+            if eligible:
+                return lp + rp, lj + conjs
+            left = self._audit_graph(lp, lj, env, cost)
+            return self._finish_outer(node, left, rp, rj, env, cost)
+        # (b2): null-introducing chunk side — single build part on the
+        # left, single chunked scan on the right, the join being the
+        # SELECT's whole FROM, and no remaining WHERE conjunct beyond
+        # those the planner consumes below the join (build-side only)
+        if len(lp) != 1 or lp[0].chunked:
+            return None
+        rp, rj = self._flatten_from(node.right, env, cost, top=False)
+        eligible = top and len(rp) == 1 and not rj and rp[0].chunked and \
+            plain_pairs(rp[0]) is not None
+        if eligible:
+            for c in (where or []):
+                if _has_subquery(c):
+                    eligible = False
+                    break
+                refs = _column_refs(c)
+                # conjuncts fully on the build side are consumed below
+                # the join by the planner (lw) and do not block
+                if refs and all(lp[0].owns(r) for r in refs):
+                    continue
+                eligible = False
+                break
+        if eligible:
+            lp[0].single_row = False
+            return rp + lp, lj + conjs
+        left = self._audit_graph(lp, lj, env, cost)
+        return self._finish_outer(node, left, rp, rj, env, cost)
 
     def _prune(self, widths: dict) -> dict:
         if self.needed is None:
@@ -1122,11 +1211,12 @@ class MemAuditor:
         part_cols = [p.colset() for p in parts]
         sources = [p.source for p in parts]
         batches: dict = {}
-        unprovable = False
         for c in conjuncts:
             if _has_subquery(c):
+                # multi-pass streaming: the subquery pre-plans into a
+                # device residual and the conjunct filters joined rows —
+                # it neither grows rows nor breaks the proof
                 self._walk_subqueries(c, env, cost)
-                unprovable = True
                 continue
             e = _equi_sides(c, part_cols)
             if e is not None:
@@ -1181,8 +1271,7 @@ class MemAuditor:
             if i != keep:
                 cost.peak += _bucket(parts[i].rows) * parts[i].width
         kept = parts[keep]
-        k = None if unprovable else stream_graph_fanout(
-            part_cols, sources, keep, conjuncts)
+        k = stream_graph_fanout(part_cols, sources, keep, conjuncts)
         chunk_bytes = self.model.chunk_cap() * kept.width
         n_parts, part_rows, part_bytes = 1, None, None
         if k is not None:

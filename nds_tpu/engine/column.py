@@ -69,7 +69,12 @@ class Column:
         """Nulls among the first ``nrows`` rows (pass the table's logical
         count — host int or DeviceCount — for a bucket-padded column; pad
         slots carry garbage validity). This is a host read: it syncs, and
-        the sync is counted."""
+        the sync is counted. Inside a stream-bounds region the value is a
+        RECORDED scalar with a device-side staleness guard
+        (:func:`ops.guarded_scalar_read`): the first chunk's count replays
+        for every chunk, and any chunk whose live count differs flips the
+        pipeline's overflow flag (eager rerun) instead of silently using a
+        stale decision — the `chunk-dependent-host-read` conversion."""
         if self.valid is None:
             return 0
         from nds_tpu.engine import ops as _ops
@@ -82,7 +87,7 @@ class Column:
                 or int(nrows) < int(self.data.shape[0])):
             invalid = invalid & (
                 jnp.arange(self.data.shape[0]) < _ops.count_arr(nrows))
-        return _ops.host_sync(jnp.sum(invalid))
+        return _ops.guarded_scalar_read("null_count", jnp.sum(invalid))
 
     def take(self, indices) -> "Column":
         # clip mode: out-of-range pad indices duplicate a real row, so pad

@@ -200,6 +200,105 @@ def stream_overflow(pred) -> None:
         flags.append(pred)
 
 
+class _OuterMatchCollector:
+    """Collects the per-dispatch matched-build-row masks an outer-build
+    join registers (multi-pass streaming, engine/stream.py): the streamed
+    pipeline ORs them into a device-resident unmatched-key accumulator so
+    the outer-extras rows can be emitted once, at materialize time."""
+
+    def __enter__(self):
+        self._prev = getattr(_sync_tls, "stream_outer", None)
+        self.masks: list = []
+        _sync_tls.stream_outer = self.masks
+        return self
+
+    def __exit__(self, *exc):
+        _sync_tls.stream_outer = self._prev
+
+
+def outer_match_collector():
+    return _OuterMatchCollector()
+
+
+def stream_outer_matched(mask) -> None:
+    """Register the device bool vector of build-side rows the current
+    outer-build join dispatch matched. No-op outside a collector region
+    (plain device-resident outer joins resolve their extras inline)."""
+    lst = getattr(_sync_tls, "stream_outer", None)
+    if lst is not None:
+        lst.append(mask)
+
+
+class _SuspendStreamRecord:
+    """Escape hatch for CHUNK-INVARIANT inner plans reached from inside a
+    streamed pipeline's record phase (subquery residuals): restores plain
+    eager execution — replay log detached (inner host reads must never
+    interleave with the outer recording, which the trace would then fail
+    to consume), stream-bounds off (the inner plan may sync freely; it
+    runs ONCE, not per chunk), and a FRESH pending-count/check list so the
+    inner's batched resolutions never drain counts the outer record phase
+    still owes its log."""
+
+    def __enter__(self):
+        t = _sync_tls
+        self._saved = (
+            replay_mode(), getattr(t, "replay_log", None),
+            getattr(t, "replay_cursor", 0),
+            getattr(t, "replay_operands", None),
+            stream_bounds_on(), getattr(t, "stream_flags", None),
+            getattr(t, "stream_outer", None),
+            getattr(t, "pending", None), getattr(t, "checks", None))
+        t.replay_mode = "off"
+        t.replay_log = None
+        t.replay_cursor = 0
+        t.replay_operands = None
+        t.stream_bounds = False
+        t.stream_flags = None
+        t.stream_outer = None
+        t.pending = []
+        t.checks = []
+        return self
+
+    def __exit__(self, *exc):
+        t = _sync_tls
+        (t.replay_mode, t.replay_log, t.replay_cursor, t.replay_operands,
+         t.stream_bounds, t.stream_flags, t.stream_outer,
+         t.pending, t.checks) = self._saved
+
+
+def suspend_stream_record():
+    return _SuspendStreamRecord()
+
+
+def guarded_scalar_read(tag: str, dev_scalar) -> int:
+    """Mechanism for CHUNK-DERIVED host scalars inside the streamed
+    pipeline (the `chunk-dependent-host-read` conversion): outside a
+    stream-bounds region this is an ordinary counted host read. Inside
+    one, the value read on the FIRST chunk is recorded and replayed for
+    every later chunk — with a device-side STALENESS GUARD registered on
+    the overflow channel, so any chunk for which the recorded value's
+    validity predicate fails (the live value differs) flips the pipeline's
+    overflow flag and the statement re-runs eagerly, bit-for-bit. The
+    guard is what makes replaying a recorded scalar SOUND rather than
+    hopeful."""
+    import jax.numpy as _jnp
+
+    def fetch():
+        add_syncs()
+        t0 = time.perf_counter_ns()
+        out = int(jax.device_get(dev_scalar))
+        add_sync_wait(time.perf_counter_ns() - t0)
+        return out
+
+    if not stream_bounds_on():
+        return host_read(tag, fetch)
+    # replay serves the recorded value without touching fetch; record
+    # fetches (one counted sync, first chunk only) and logs it
+    val = host_read(tag, fetch)
+    stream_overflow(_jnp.asarray(dev_scalar) != val)
+    return val
+
+
 def replay_mode() -> str:
     return getattr(_sync_tls, "replay_mode", "off")
 

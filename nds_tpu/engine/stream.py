@@ -34,7 +34,34 @@ device-resident queries, specialized to the streaming shape:
 
 The eager loop remains reachable as ``NDS_TPU_STREAM_EXEC=eager`` (escape
 hatch) and as the automatic fallback for graphs that are not
-chunk-invariant (outer-join extras, cartesians, subquery residuals).
+chunk-invariant (cartesian layouts, exotic trace divergence).
+
+MULTI-PASS streamed pipelines convert the formerly-eager shapes:
+
+* **Subquery residuals** — a subquery nested in the graph's conjuncts is
+  chunk-invariant once decorrelated, so the record phase plans the inner
+  query FIRST (``Planner._residual_table`` under
+  ``ops.suspend_stream_record()`` — the inner may use its own compiled
+  pipeline; two pipelines chain with one materializing sync each) into a
+  device-resident residual whose columns become ordinary jit operands of
+  the per-chunk program. Cache hits re-plan the residuals per execution
+  and shape-validate them against the compiled program.
+* **Deferred outer joins** — an eligible LEFT join rides INTO the graph:
+  ``_OuterProbe`` (chunked side preserved, ON keys = the probe side's
+  PK) applies a sync-free per-chunk gather; ``_OuterBuild`` (chunked
+  side null-introducing) emits per-dispatch matched pairs and ORs
+  matched-build-row masks into an on-device unmatched-key accumulator —
+  the outer extras emit once at materialize time, their counts riding
+  the single materializing transfer.
+* **Recorded chunk scalars** — ``ops.guarded_scalar_read`` replays a
+  first-chunk host scalar for every chunk under a device-side staleness
+  guard (mismatch ⇒ overflow flag ⇒ bit-for-bit eager rerun).
+
+``NDS_TPU_STREAM_STRICT=1`` re-raises any record/trace failure that is
+not a ``StreamSyncError``/``ReplayMismatch`` (the A/B tests and both
+differential harnesses run strict); without it the fallback reason is
+tagged with the exception class, so engine bugs stay auditable in
+``streamedScans``.
 
 Survivor accumulators are sized from the statement's PROVEN row bound
 (the static memory model of ``nds_tpu/analysis/mem_audit.py``: schema PK
@@ -107,22 +134,40 @@ def _acc_ceiling() -> int | None:
     return int(env) if env else None
 
 
+def _strict() -> bool:
+    """NDS_TPU_STREAM_STRICT=1: re-raise any record/trace failure that is
+    not a StreamSyncError/ReplayMismatch instead of converting it into an
+    eager fallback — the mode both differential harnesses and the A/B
+    tests run under, so a genuine engine bug can never hide behind the
+    fallback's correctness guarantee."""
+    return bool(os.environ.get("NDS_TPU_STREAM_STRICT"))
+
+
 def _proved_plan(parts, keep, join_preds, where_conjuncts, sources, nrows):
     """``(proved_rows, k, part_keys)`` of the streamed graph, from the
     static memory model (analysis/mem_audit.py): the whole-statement
     survivor bound ``bucket(rows) x fanout^k`` (k = join batches with no
     PK-unique side), plus the chunk-side equi-key names a grace-style
-    partition pass may hash on. ``(None, None, None)`` when unprovable
-    (subquery conjunct / unconnected graph — the trace diverges there and
-    the eager loop serves the query anyway)."""
+    partition pass may hash on. Deferred outer joins (_OuterProbe /
+    _OuterBuild) contribute their ON conjuncts and pristine sources —
+    their PK-covered edges keep per-row multiplicity at <= 1 exactly like
+    inner PK batches. ``(None, None, None)`` when unprovable (unconnected
+    graph — a chunk-data-dependent cartesian layout the eager loop serves
+    anyway)."""
     try:
         from nds_tpu.analysis.mem_audit import (stream_graph_fanout,
                                                 stream_partition_keys,
                                                 structural_row_bound)
+        from nds_tpu.sql.planner import _OuterBuild, _OuterProbe
         part_cols = [{str(c).lower() for c in p.column_names}
                      for p in parts]
-        srcs = [s.lower() if isinstance(s, str) else None for s in sources]
+        srcs = list(sources)
         conj = list(join_preds) + list(where_conjuncts)
+        for i, p in enumerate(parts):
+            if isinstance(p, (_OuterProbe, _OuterBuild)):
+                srcs[i] = p.src
+                conj.extend(p.conjuncts)
+        srcs = [s.lower() if isinstance(s, str) else None for s in srcs]
         k = stream_graph_fanout(part_cols, srcs, keep, conj)
         if k is None:
             return None, None, None
@@ -303,7 +348,9 @@ class StreamPipeline:
 
     def __init__(self, chunk_spec, chunk_cap, part_specs, keep, log_entries,
                  operands, out_template, acc_cap, part_refs,
-                 n_partitions=1, key_slots=()):
+                 n_partitions=1, key_slots=(), outer_meta=(),
+                 residuals=(), resid_specs=(), build_slots=(),
+                 name_catalog=None):
         self.chunk_spec = chunk_spec      # ((aliased name, kind, dict), ...)
         self.chunk_cap = chunk_cap
         self.part_specs = part_specs      # specs of non-streamed parts
@@ -319,6 +366,17 @@ class StreamPipeline:
         self.part_refs = part_refs
         self.n_partitions = n_partitions
         self.key_slots = tuple(key_slots)
+        # multi-pass streaming metadata: per non-keep part, None or the
+        # deferred-outer-join marker ("probe"/"build", condition AST,
+        # conjunct ASTs, src); subquery residuals as (registry key,
+        # replan payload) plus their flattened specs (validated against a
+        # fresh replan on every cache hit); build_slots index the
+        # part_specs whose unmatched-key bitmaps the accumulator carries
+        self.outer_meta = tuple(outer_meta)
+        self.residuals = tuple(residuals)
+        self.resid_specs = tuple(resid_specs)
+        self.build_slots = tuple(build_slots)
+        self.name_catalog = dict(name_catalog or {})
         self.jitted = None
         self._pid_jit = None
         # first jitted dispatch traces+compiles the per-chunk program;
@@ -328,7 +386,7 @@ class StreamPipeline:
     # ------------------------------------------------------------- compile
 
     def compile(self, join_preds, where_conjuncts, sources):
-        from nds_tpu.sql.planner import Planner
+        from nds_tpu.sql.planner import Planner, _OuterBuild, _OuterProbe
         chunk_spec, chunk_cap = self.chunk_spec, self.chunk_cap
         part_specs, keep = self.part_specs, self.keep
         rec_log, operands = self.log, self.operands
@@ -336,10 +394,15 @@ class StreamPipeline:
         acc_cap = self.acc_cap
         base_sources = list(sources)
         n_partitions, key_slots = self.n_partitions, self.key_slots
+        outer_meta = self.outer_meta
+        residual_keys = tuple(k for (k, _p) in self.residuals)
+        resid_specs = self.resid_specs
+        n_builds = len(self.build_slots)
+        name_cat = self.name_catalog
 
         def traced(chunk_flat, n_dev, parts_flat, ops_flat, acc,
-                   pids=None, part_id=None):
-            acc_datas, acc_valids, acc_n, acc_ovf = acc
+                   resid_flat, pids=None, part_id=None):
+            acc_datas, acc_valids, acc_n, acc_ovf, acc_outer = acc
             cols, i = {}, 0
             for (aname, kind, dv) in chunk_spec:
                 cols[aname] = Column(kind, chunk_flat[i], chunk_flat[i + 1],
@@ -363,24 +426,42 @@ class StreamPipeline:
                 if j == keep:
                     sub.append(chunk)
                     continue
-                sub.append(_rebuild_part(part_specs[pi], parts_flat[pi]))
+                t = _rebuild_part(part_specs[pi], parts_flat[pi])
+                meta = outer_meta[pi] if pi < len(outer_meta) else None
+                if meta is not None:
+                    mk, mcond, mconjs, msrc = meta
+                    t = (_OuterProbe if mk == "probe" else _OuterBuild)(
+                        t, mcond, list(mconjs), msrc)
+                sub.append(t)
                 pi += 1
             # a fresh planner with an EMPTY catalog: the per-chunk program
             # must close over no device-resident state (a cached pipeline
-            # would pin it for process lifetime); any path that needs the
-            # catalog (subquery residuals) fails this trace and the query
-            # stays on the eager loop
+            # would pin it for process lifetime). Subquery residuals are
+            # pre-planned DEVICE OPERANDS: the registry is seeded from the
+            # pipeline's residual arguments, so the subquery eval arms
+            # consume them without ever touching a catalog
             pl = Planner({}, base_tables=set())
+            pl.name_catalog = name_cat
+            for rkey, rspec, rflat in zip(residual_keys, resid_specs,
+                                          resid_flat):
+                pl._subquery_residuals[rkey] = (
+                    None, _rebuild_part(rspec, rflat))
             with E.replaying(rec_log, ops_flat):
                 with E.stream_bounds() as sb:
-                    out = pl._join_parts(sub, list(join_preds),
-                                         list(where_conjuncts),
-                                         list(base_sources))
+                    with E.outer_match_collector() as omc:
+                        out = pl._join_parts(sub, list(join_preds),
+                                             list(where_conjuncts),
+                                             list(base_sources))
                     flags = list(sb.flags)
+                    matched = list(omc.masks)
             if list(out.column_names) != list(names):
                 raise E.ReplayMismatch(
                     "streamed trace produced a different output schema "
                     "than the recording")
+            if len(matched) != n_builds:
+                raise E.ReplayMismatch(
+                    "streamed trace registered a different outer-build "
+                    "mask count than the recording")
             out_n = E.count_arr(out.nrows)
             live = jnp.arange(out.plen) < out_n
             pos = jnp.where(live, acc_n + jnp.arange(out.plen), acc_cap)
@@ -399,7 +480,9 @@ class StreamPipeline:
             ovf = acc_ovf | (new_n > acc_cap)
             for f in flags:
                 ovf = ovf | f
-            return tuple(new_datas), tuple(new_valids), new_n, ovf
+            new_outer = tuple(b | m for b, m in zip(acc_outer, matched))
+            return (tuple(new_datas), tuple(new_valids), new_n, ovf,
+                    new_outer)
 
         # donate the accumulators: the pipeline's working set stays
         # (chunk in flight) + (chunk uploading) + ONE accumulator copy
@@ -443,17 +526,33 @@ class StreamPipeline:
             datas.append(jnp.zeros(self.acc_cap, dtype=dtype))
             valids.append(jnp.zeros(self.acc_cap, dtype=bool)
                           if valided[j] else jnp.zeros((), dtype=bool))
+        outer = tuple(jnp.zeros(self.part_specs[s][2], dtype=bool)
+                      for s in self.build_slots)
         return (tuple(datas), tuple(valids),
-                jnp.asarray(0, dtype=jnp.int64), jnp.asarray(False))
+                jnp.asarray(0, dtype=jnp.int64), jnp.asarray(False), outer)
 
-    def run(self, chunks, first_chunk, parts_flat):
+    def _outer_miss(self, bitmaps):
+        """(miss mask, device miss count) per outer-build slot: build
+        rows no dispatch matched — the outer extras. The counts ride the
+        single materializing transfer; the masks stay on device for the
+        extras gather."""
+        out = []
+        for slot, bm in zip(self.build_slots, bitmaps):
+            _spec, n_live, plen = self.part_specs[slot]
+            miss = ~bm & (jnp.arange(plen) < n_live)
+            out.append((miss, jnp.sum(miss)))
+        return out
+
+    def run(self, chunks, first_chunk, parts_flat, resid_flat=()):
         """Drive every chunk through the compiled program; returns
-        ``(survivor DeviceTable | None-on-overflow, n_chunks,
-        partition_evidence | None)`` (overflow => the caller re-runs
-        eagerly). ``chunks`` continues AFTER ``first_chunk`` (already
-        converted)."""
+        ``(survivor DeviceTable | None-on-overflow, n_chunks, evidence)``
+        (overflow => the caller re-runs eagerly). ``evidence`` carries the
+        partition counts of a partitioned run and the outer-extras
+        masks/counts of deferred outer-build joins. ``chunks`` continues
+        AFTER ``first_chunk`` (already converted)."""
         if self.n_partitions > 1:
-            return self._run_partitioned(chunks, first_chunk, parts_flat)
+            return self._run_partitioned(chunks, first_chunk, parts_flat,
+                                         resid_flat)
         acc = self.init_acc()
         cur = first_chunk
         n_chunks = 0
@@ -469,25 +568,32 @@ class StreamPipeline:
             phase = "stream.drive" if self.traced_once else "stream.compile"
             with _obs.span(phase, chunk=n_chunks):
                 acc = self.jitted(self._flatten_chunk(cur), n_dev,
-                                  parts_flat, self.operands, acc)
+                                  parts_flat, self.operands, acc,
+                                  resid_flat)
             self.traced_once = True
             n_chunks += 1
             # prefetch span: host-side arrow slice + upload of the next
             # chunk, overlapping the dispatched compute above
             with _obs.span("stream.prefetch", chunk=n_chunks):
                 cur = next(chunks, None)
-        datas, valids, n_dev, ovf = acc
+        datas, valids, n_dev, ovf, bitmaps = acc
+        miss = self._outer_miss(bitmaps)
 
         def fetch():
-            total, overflowed = jax.device_get([n_dev, ovf])
-            return int(total), bool(overflowed)
+            got = jax.device_get([n_dev, ovf] + [n for (_m, n) in miss])
+            return (int(got[0]), bool(got[1]),
+                    [int(x) for x in got[2:]])
 
-        # THE one materializing sync of the pipeline
+        # THE one materializing sync of the pipeline (outer-extras counts
+        # ride the same transfer)
         with _obs.span("stream.materialize", chunks=n_chunks):
-            total, overflowed = E.timed_read("stream_final", fetch)
+            total, overflowed, extras_n = E.timed_read("stream_final",
+                                                       fetch)
+        evidence = {"outer": [(slot, m, n) for (slot, (m, _nd), n)
+                              in zip(self.build_slots, miss, extras_n)]}
         if overflowed:
-            return None, n_chunks, None
-        return self._slice_acc(datas, valids, total), n_chunks, None
+            return None, n_chunks, evidence
+        return self._slice_acc(datas, valids, total), n_chunks, evidence
 
     def _slice_acc(self, datas, valids, total):
         """Survivor prefix of one accumulator as a DeviceTable."""
@@ -501,7 +607,8 @@ class StreamPipeline:
                 else col
         return DeviceTable(cols, total, plen=min(cap, self.acc_cap))
 
-    def _run_partitioned(self, chunks, first_chunk, parts_flat):
+    def _run_partitioned(self, chunks, first_chunk, parts_flat,
+                         resid_flat=()):
         """Grace-style drive: each chunk uploads ONCE, the partition pass
         assigns row partition ids (histogram stays device-resident), and
         the one compiled program dispatches once per partition into that
@@ -509,7 +616,10 @@ class StreamPipeline:
         double-buffered prefetch; partition-major survivor order is
         row-order-independent downstream (joins/filters/aggregation
         distribute over union). ONE materializing sync fetches every
-        partition's count + overflow flag + the input histogram."""
+        partition's count + overflow flag + the input histogram (+ any
+        outer-extras counts: per-partition unmatched-key bitmaps OR
+        together first — a build row matched by ANY partition of ANY
+        chunk is matched)."""
         P = self.n_partitions
         accs = [self.init_acc() for _ in range(P)]
         hist = jnp.zeros(P, dtype=jnp.int64)
@@ -528,28 +638,37 @@ class StreamPipeline:
                 with _obs.span(phase, chunk=n_chunks, part=p):
                     accs[p] = self.jitted(flat, n_dev, parts_flat,
                                           self.operands, accs[p],
-                                          pids=pids,
+                                          resid_flat, pids=pids,
                                           part_id=pid_consts[p])
                 self.traced_once = True
             n_chunks += 1
             with _obs.span("stream.prefetch", chunk=n_chunks):
                 cur = next(chunks, None)
 
+        bitmaps = [accs[0][4][j] for j in range(len(self.build_slots))]
+        for p in range(1, P):
+            bitmaps = [b | accs[p][4][j] for j, b in enumerate(bitmaps)]
+        miss = self._outer_miss(bitmaps)
+
         def fetch():
             got = jax.device_get([a[2] for a in accs]
-                                 + [a[3] for a in accs] + [hist])
+                                 + [a[3] for a in accs] + [hist]
+                                 + [n for (_m, n) in miss])
             return ([int(x) for x in got[:P]],
                     [bool(x) for x in got[P:2 * P]],
-                    [int(x) for x in got[2 * P]])
+                    [int(x) for x in got[2 * P]],
+                    [int(x) for x in got[2 * P + 1:]])
 
         # still THE one materializing sync: P counts + P flags + the
-        # histogram ride one transfer
+        # histogram (+ extras counts) ride one transfer
         with _obs.span("stream.materialize", chunks=n_chunks,
                        partitions=P):
-            totals, overflowed, hist_host = E.timed_read("stream_final",
-                                                         fetch)
+            totals, overflowed, hist_host, extras_n = E.timed_read(
+                "stream_final", fetch)
         evidence = {"partitions": P, "part_rows": tuple(totals),
-                    "part_input": tuple(hist_host)}
+                    "part_input": tuple(hist_host),
+                    "outer": [(slot, m, n) for (slot, (m, _nd), n)
+                              in zip(self.build_slots, miss, extras_n)]}
         if any(overflowed):
             return None, n_chunks, evidence
         tables = [self._slice_acc(accs[p][0], accs[p][1], totals[p])
@@ -581,7 +700,7 @@ def _dicts_equal(a, b) -> bool:
 
 
 def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
-               part_infos, chunk_spec, chunk_cap, stream_rows):
+               part_infos, chunk_spec, chunk_cap, stream_rows, outer_meta):
     from nds_tpu.analysis.mem_audit import (stream_partitions_env,
                                             stream_skew_factor)
     from nds_tpu.sql.parser import expr_key
@@ -593,6 +712,9 @@ def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
         tuple(((tuple((cn, ck, hv) for (cn, ck, _dv, hv) in spec[0]),
                 spec[1], spec[2]))
               for (spec, _flat) in part_infos),
+        # deferred outer joins are part of the compiled program's shape
+        tuple((m[0], expr_key(m[1]), m[3]) if m else None
+              for m in outer_meta),
         # accumulator-sizing knobs: a pipeline built under a different
         # ceiling/capacity/fanout/partitioning must not be reused (its
         # compiled acc shapes bake the old budget in), and the streamed
@@ -601,6 +723,40 @@ def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
         _acc_ceiling(), _hbm_bytes(), E.stream_fanout(),
         stream_partitions_env(), stream_skew_factor(), int(stream_rows),
     )
+
+
+def _spec_match(a, b) -> bool:
+    """Structural equality of two flattened-part specs (names, kinds,
+    validity presence, logical count, physical length, dictionary
+    CONTENT) — the test a freshly replanned subquery residual must pass
+    before a cached pipeline (whose program baked the old residual's
+    shapes and recorded reads) may serve it."""
+    (ac, an, ap), (bc, bn, bp) = a, b
+    if an != bn or ap != bp or len(ac) != len(bc):
+        return False
+    for (n1, k1, d1, v1), (n2, k2, d2, v2) in zip(ac, bc):
+        if n1 != n2 or k1 != k2 or v1 != v2 or not _dicts_equal(d1, d2):
+            return False
+    return True
+
+
+def _replan_residuals(planner, pipe):
+    """Cache-hit path: re-plan every subquery residual for THIS execution
+    (its data may have changed) and flatten the results as pipeline
+    operands. Returns the flattened infos, or None when any residual's
+    shape no longer matches the cached program (caller rebuilds). The
+    replanned tables also seed the statement planner's registry, so an
+    eventual eager fallback reuses them instead of re-planning per
+    chunk."""
+    infos = []
+    for (rkey, payload), want in zip(pipe.residuals, pipe.resid_specs):
+        rt = E.resolve_table(planner._plan_residual(payload))
+        planner._subquery_residuals[rkey] = (payload, rt)
+        spec, flat = _flatten_part(rt)
+        if not _spec_match(spec, want):
+            return None
+        infos.append((spec, flat))
+    return infos
 
 
 def _cache_hit(key, chunk_spec, part_infos):
@@ -641,19 +797,32 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
         # never nest inside whole-query record/replay: the pipeline's own
         # recording would interleave with the outer log
         return None, None
+    from nds_tpu.sql.planner import _OuterBuild, _OuterProbe
     scan = parts[keep]
     chunked, alias = scan.chunked, scan.alias
     syncs0 = E.sync_count()
 
     # resolve every non-streamed part's count up front (one batched
     # transfer, usually free): part counts are per-statement constants of
-    # the compiled program
+    # the compiled program. Deferred outer joins flatten their tables like
+    # any other part; the marker metadata rides outer_meta.
     E.resolve_counts()
     part_infos = []
+    outer_meta = []
     for i, p in enumerate(parts):
         if i == keep:
             continue
-        part_infos.append(_flatten_part(p))
+        if isinstance(p, _OuterProbe):
+            part_infos.append(_flatten_part(p.table))
+            outer_meta.append(("probe", p.condition, tuple(p.conjuncts),
+                               p.src))
+        elif isinstance(p, _OuterBuild):
+            part_infos.append(_flatten_part(p.table))
+            outer_meta.append(("build", p.condition, tuple(p.conjuncts),
+                               p.src))
+        else:
+            part_infos.append(_flatten_part(p))
+            outer_meta.append(None)
     # the chunk slot must never be the dimension side of a PK-gather plan:
     # that plan fetches the dim side's key ranges on host, which would
     # bake CHUNK data into the chunk-invariant program
@@ -670,18 +839,32 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
     try:
         key = _cache_key(alias, keep, join_preds, where_conjuncts,
                          masked_sources, part_infos, chunk_spec, chunk_cap,
-                         chunked.nrows)
+                         chunked.nrows, outer_meta)
         pipe = _cache_hit(key, chunk_spec, part_infos)
     except Exception:
         pipe = None                      # unkeyable statement: no cache
     parts_flat = tuple(tuple(flat) for (_spec, flat) in part_infos)
+    resid_infos = ()
+    if pipe is not None and pipe.residuals:
+        # subquery residuals are per-EXECUTION operands: re-plan them (the
+        # inner queries stream through their own pipelines) and validate
+        # their shapes against the cached program
+        got = _replan_residuals(planner, pipe)
+        if got is None:
+            with _PIPELINE_LOCK:
+                if _PIPELINE_CACHE.get(key) is pipe:
+                    _PIPELINE_CACHE.pop(key, None)
+            pipe = None
+        else:
+            resid_infos = got
     # label the planner's enclosing "stream" span with the cache outcome
     _obs.annotate(pipelineCache="hit" if pipe is not None else "miss")
 
     if pipe is None:
-        pipe = _build_pipeline(planner, parts, keep, alias, join_preds,
-                               where_conjuncts, masked_sources, part_infos,
-                               first, chunk_spec, chunk_cap, n_chunks)
+        pipe, resid_infos = _build_pipeline(
+            planner, parts, keep, alias, join_preds, where_conjuncts,
+            masked_sources, part_infos, outer_meta, first, chunk_spec,
+            chunk_cap, n_chunks)
         if pipe is None:
             return None, "not chunk-invariant"
         if key is not None:
@@ -690,11 +873,13 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
                     _PIPELINE_CACHE.pop(next(iter(_PIPELINE_CACHE)))
                 _PIPELINE_CACHE[key] = pipe
 
+    resid_flat = tuple(tuple(flat) for (_spec, flat) in resid_infos)
     snapshot = list(E._pending_counts())
     checks_snapshot = [c for c, _f in
                        (getattr(E._sync_tls, "checks", None) or [])]
     try:
-        out, ran, part_ev = pipe.run(chunk_iter, first, parts_flat)
+        out, ran, evidence = pipe.run(chunk_iter, first, parts_flat,
+                                      resid_flat)
         # tracing the first call replays planner code that registers
         # DeviceCounts/deferred checks holding TRACER values; they belong
         # to the trace, not this execution — drop them before any
@@ -704,12 +889,21 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
             NotImplementedError, jax.errors.TracerArrayConversionError,
             jax.errors.ConcretizationTypeError,
             jax.errors.TracerBoolConversionError) as exc:
-        # first-call trace divergence: unstreamable after all
+        # first-call trace divergence: unstreamable after all. The reason
+        # carries the exception CLASS so a fallback caused by a genuine
+        # engine bug (ValueError/TypeError/...) is distinguishable from
+        # the two legitimate routing exceptions; NDS_TPU_STREAM_STRICT=1
+        # re-raises everything else outright (the diff harnesses and the
+        # A/B tests run strict).
         _restore_counts(snapshot, checks_snapshot)
         with _PIPELINE_LOCK:
             _PIPELINE_CACHE.pop(key, None)
+        if _strict() and not isinstance(exc, (E.StreamSyncError,
+                                              E.ReplayMismatch)):
+            raise
         log.info("streamed pipeline fell back to eager: %s", exc)
-        return None, f"trace diverged: {exc}"
+        return None, f"trace diverged [{type(exc).__name__}]: {exc}"
+    evidence = evidence or {}
     if out is None:
         # device-side overflow (partitioned: some partition's enforced
         # per-partition bucket): rows were dropped, rerun eagerly. Keep
@@ -718,22 +912,40 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
         log.info("streamed pipeline overflowed its bound buckets; "
                  "re-running %s eagerly", alias)
         return None, "bound-bucket overflow"
-    part_ev = part_ev or {}
+    survivor_total = int(out.nrows)
+    # deferred outer-build joins: emit the outer extras ONCE, from the
+    # unmatched-key bitmaps the pipeline accumulated (counts rode the
+    # single materializing transfer — no extra sync)
+    extras = []
+    nonkeep_parts = [p for i, p in enumerate(parts) if i != keep]
+    for (slot, miss_mask, n_extras) in evidence.get("outer", ()):
+        if not n_extras:
+            continue
+        from nds_tpu.sql.planner import outer_extras_table
+        idx = E.compact_indices(miss_mask, n_extras)
+        extras.append(outer_extras_table(nonkeep_parts[slot].table, idx,
+                                         n_extras, out))
+    if extras:
+        out = E.concat_tables([out] + extras)
     record_stream_event(alias, ran, E.sync_count() - syncs0, "compiled",
-                        rows=int(out.nrows),
-                        partitions=part_ev.get("partitions", 1),
-                        part_rows=part_ev.get("part_rows", ()))
+                        rows=survivor_total,
+                        partitions=evidence.get("partitions", 1),
+                        part_rows=evidence.get("part_rows", ()))
     _obs.annotate(path="compiled", chunks=ran,
-                  partitions=part_ev.get("partitions", 1))
+                  partitions=evidence.get("partitions", 1))
     return out, None
 
 
 def _build_pipeline(planner, parts, keep, alias, join_preds,
-                    where_conjuncts, masked_sources, part_infos, first,
-                    chunk_spec, chunk_cap, n_chunks):
+                    where_conjuncts, masked_sources, part_infos,
+                    outer_meta, first, chunk_spec, chunk_cap, n_chunks):
     """RECORD the per-chunk join graph on the first padded chunk and
-    compile the chunk-invariant program; None when not streamable."""
+    compile the chunk-invariant program; ``(None, None)`` when not
+    streamable. Returns ``(pipe, resid_infos)`` — the flattened subquery
+    residuals the record phase pre-planned, which are THIS execution's
+    residual operands."""
     from nds_tpu.engine.replay import _lift_log
+    from nds_tpu.sql.planner import _OuterBuild, _OuterProbe
     snapshot = list(E._pending_counts())
     checks_snapshot = [c for c, _f in
                        (getattr(E._sync_tls, "checks", None) or [])]
@@ -748,20 +960,57 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
     for i in range(len(parts)):
         if i == keep:
             continue
-        sub[i] = _rebuild_part(part_infos[pi][0], part_infos[pi][1])
+        t = _rebuild_part(part_infos[pi][0], part_infos[pi][1])
+        meta = outer_meta[pi]
+        if meta is not None:
+            mk, mcond, mconjs, msrc = meta
+            t = (_OuterProbe if mk == "probe" else _OuterBuild)(
+                t, mcond, list(mconjs), msrc)
+        sub[i] = t
         pi += 1
+    # save/restore: a subquery residual planned DURING this record may
+    # itself stream through a nested pipeline build on the same planner —
+    # its record must not clobber the outer record's touched list
+    prev_touched = planner._residuals_touched
+    planner._residuals_touched = touched = []
     try:
         with _obs.span("stream.record", table=alias):
             with E.recording() as rec_log:
                 with E.stream_bounds():
-                    out0 = planner._join_parts(sub, list(join_preds),
-                                               list(where_conjuncts),
-                                               list(masked_sources))
+                    with E.outer_match_collector() as omc:
+                        out0 = planner._join_parts(sub, list(join_preds),
+                                                   list(where_conjuncts),
+                                                   list(masked_sources))
     except E.StreamSyncError as exc:
         log.info("streamed scan %s not chunk-invariant: %s", alias, exc)
-        return None
+        return None, None
     finally:
+        planner._residuals_touched = prev_touched
         _restore_counts(snapshot, checks_snapshot)
+    # subquery residuals the record phase planned (or reused): they become
+    # jit operands of the per-chunk program
+    resid_infos = [_flatten_part(rt) for (_k, _p, rt) in touched]
+    residuals = [(k, p) for (k, p, _rt) in touched]
+    # names-only catalog snapshot: the traced planner's correlation
+    # analysis (_find_correlation/_select_output_cols) must resolve
+    # subquery scopes exactly like the record phase did, without closing
+    # over any device-resident table
+    name_cat = {}
+    if residuals:
+        for scope in planner.cte_stack:
+            for k, t in scope.items():
+                name_cat[k.lower()] = tuple(t.column_names)
+        for k, t in planner.catalog.items():
+            name_cat.setdefault(k.lower(), tuple(t.column_names))
+    # outer-build bitmap slots: the record phase registered one matched
+    # mask per deferred outer-build join, in part order
+    build_slots = [i for i, m in enumerate(outer_meta)
+                   if m is not None and m[0] == "build"]
+    if len(omc.masks) != len(build_slots):
+        log.info("streamed scan %s: outer-build mask count mismatch "
+                 "(%d masks, %d builds)", alias, len(omc.masks),
+                 len(build_slots))
+        return None, None
     names = list(out0.column_names)
     template = (names,
                 [out0[n].kind for n in names],
@@ -801,12 +1050,17 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
                                  max(row_bytes, 1))
     acc_cap = E.bucket_len(max(budget, out0.plen))
     _obs.annotate(accRows=acc_cap, partitions=n_parts,
-                  provedRows=proved if proved is not None else "unproven")
+                  provedRows=proved if proved is not None else "unproven",
+                  residuals=len(residuals), outerBuilds=len(build_slots))
     lifted, operands = _lift_log(list(rec_log))
     pipe = StreamPipeline(
         chunk_spec, chunk_cap,
         tuple(spec for (spec, _flat) in part_infos), keep, lifted,
         tuple(operands), template, acc_cap,
         [_weak(x) for (_spec, flat) in part_infos for x in flat],
-        n_partitions=n_parts, key_slots=key_slots)
-    return pipe.compile(join_preds, where_conjuncts, masked_sources)
+        n_partitions=n_parts, key_slots=key_slots,
+        outer_meta=outer_meta, residuals=residuals,
+        resid_specs=tuple(spec for (spec, _flat) in resid_infos),
+        build_slots=build_slots, name_catalog=name_cat)
+    return (pipe.compile(join_preds, where_conjuncts, masked_sources),
+            resid_infos)

@@ -671,7 +671,29 @@ def expr_key(e) -> str:
         part = ",".join(expr_key(p) for p in e.spec.partition_by)
         order = ",".join(f"{expr_key(oe)}:{d}:{nl}" for oe, d, nl in e.spec.order_by)
         return f"win:{expr_key(e.func)}|p={part}|o={order}|f={e.spec.frame}"
+    if isinstance(e, (A.ScalarSubquery, A.InSubquery, A.Exists,
+                      A.QuantifiedCompare, A.Query, A.Select)) or \
+            hasattr(e, "__dataclass_fields__"):
+        # subquery/statement nodes key by STRUCTURE, not object identity:
+        # the streamed-residual machinery (engine/stream.py) keys
+        # pre-planned subquery results — and the pipeline cache keys
+        # conjuncts — on expr_key, so two parses of the same text agree
+        fields = ",".join(f"{k}={_node_key(v)}" for k, v in vars(e).items())
+        return f"{type(e).__name__.lower()}({fields})"
     return f"obj:{id(e)}"
+
+
+def _node_key(x) -> str:
+    """Deterministic structural key of an arbitrary AST node (dataclass
+    fields walked recursively; expressions delegate to :func:`expr_key`)."""
+    if isinstance(x, A.Expr):
+        return expr_key(x)
+    if isinstance(x, (list, tuple)):
+        return "[" + ",".join(_node_key(i) for i in x) + "]"
+    if hasattr(x, "__dataclass_fields__"):
+        fields = ",".join(f"{k}={_node_key(v)}" for k, v in vars(x).items())
+        return f"{type(x).__name__}({fields})"
+    return repr(x)
 
 
 def parse(sql: str):

@@ -89,6 +89,65 @@ class _StreamedScan:
         return planner._alias_table(self.chunked.materialize(), self.alias)
 
 
+class _OuterProbe:
+    """A deferred LEFT join whose PRESERVED side holds the >HBM chunked
+    scan (q40/q78/q80/q93: ``fact left join returns on returns-PK``).
+    The join rides INTO the streamed graph: every chunk applies the
+    sync-free PK gather against the whole probe table inside the compiled
+    per-chunk program (``Planner._apply_outer``), so nothing materializes
+    whole and the per-chunk unmatched rows — which distribute over the
+    preserved side's chunks — null-extend in place."""
+
+    def __init__(self, table: DeviceTable, condition, conjuncts, src):
+        self.table = table          # alias-qualified device table
+        self.condition = condition  # the original ON expression (AST)
+        self.conjuncts = list(conjuncts)
+        self.src = src              # pristine catalog name (PK provenance)
+
+    @property
+    def column_names(self):
+        return self.table.column_names
+
+
+class _OuterBuild:
+    """A deferred LEFT join whose NULL-INTRODUCING side holds the chunked
+    scan (q5: ``returns left join sales on sales-PK``). Each chunk emits
+    its matched pairs through an inner bound-bucket join and registers the
+    matched-build-row mask (``ops.stream_outer_matched``); the pipeline
+    ORs the masks into an on-device unmatched-key accumulator and the
+    outer extras — build rows no chunk matched — are emitted ONCE at
+    materialize time, null-extended to the joined schema."""
+
+    def __init__(self, table: DeviceTable, condition, conjuncts, src):
+        self.table = table
+        self.condition = condition
+        self.conjuncts = list(conjuncts)
+        self.src = src
+
+    @property
+    def column_names(self):
+        return self.table.column_names
+
+
+def outer_extras_table(build: DeviceTable, idx, n_extras,
+                       template: DeviceTable) -> DeviceTable:
+    """The outer-extras rows of a deferred outer-build join: unmatched
+    build rows gathered by ``idx``, null-extended to the joined output
+    schema of ``template`` (columns the build side does not provide come
+    back NULL, exactly like the extras arm of a materialized left join)."""
+    cols = {}
+    cap = int(idx.shape[0])
+    for n in template.column_names:
+        t = template[n]
+        if n in build.columns:
+            cols[n] = build[n].take(idx)
+        else:
+            data = jnp.zeros((cap,) + t.data.shape[1:], dtype=t.data.dtype)
+            cols[n] = Column(t.kind, data, jnp.zeros(cap, dtype=bool),
+                             t.dict_values)
+    return DeviceTable(cols, n_extras, plen=cap)
+
+
 def _table_bytes(t) -> int:
     """Resident byte size of a catalog table (device columns or a
     host-resident ChunkedTable) — the scanBytes term of the per-query
@@ -114,6 +173,16 @@ class Planner:
         # roofline accounting: catalog tables this statement actually bound,
         # with their resident byte sizes (per-query scanBytes in summaries)
         self.scanned: dict[str, int] = {}
+        # multi-pass streaming: per-statement registry of pre-planned
+        # subquery residuals (device-resident inner results keyed by the
+        # subquery's structural expr_key). Populated by the streamed
+        # pipeline's record phase — and by the first eager chunk — so the
+        # per-chunk program consumes each residual as an ordinary device
+        # operand instead of re-planning the subquery per chunk.
+        self._subquery_residuals: dict = {}
+        # while a pipeline records, the residual keys the record phase
+        # touched (registry hits included) — the pipeline's operand list
+        self._residuals_touched: list | None = None
 
     # ------------------------------------------------------------------ query
 
@@ -356,7 +425,7 @@ class Planner:
         parts, join_preds, sources = self._flatten_from(from_)
         return self._join_parts(parts, join_preds, [], sources)
 
-    def _flatten_from(self, from_, where=None):
+    def _flatten_from(self, from_, where=None, top=True):
         """Flatten a FROM tree into (leaf tables, explicit-join predicates,
         per-leaf catalog source names). Cross/comma joins AND structured
         INNER joins flatten into the list — an inner ON predicate is
@@ -367,7 +436,11 @@ class Planner:
         by the null-preserving side are consumed from ``where`` (a mutable
         list) and pushed below the join. ``sources[i]`` names the catalog
         table a leaf scans (None for subqueries/materialized joins) — the
-        provenance the PK gather-join optimization keys on."""
+        provenance the PK gather-join optimization keys on. ``top`` is
+        True only for the SELECT's whole FROM node: the outer-BUILD
+        deferral (mechanism b2) is sound only there — a parent join
+        around it would filter/extend rows the materialize-time extras
+        cannot see."""
         if isinstance(from_, A.TableRef):
             alias = from_.alias or from_.name
             name_l = from_.name.lower()
@@ -403,21 +476,64 @@ class Planner:
             return [self._alias_table(t, from_.alias)], [], [None]
         if isinstance(from_, A.Join):
             if from_.kind in ("cross", "inner"):
-                lp, lj, ls = self._flatten_from(from_.left, where)
-                rp, rj, rs = self._flatten_from(from_.right, where)
+                lp, lj, ls = self._flatten_from(from_.left, where,
+                                                top=False)
+                rp, rj, rs = self._flatten_from(from_.right, where,
+                                                top=False)
                 cond = [h for c in self._split_conjuncts(from_.condition)
                         for h in self._hoist_or_conjuncts(c)]
                 return lp + rp, lj + rj + cond, ls + rs
             # outer join: materialize it, pushing WHERE conjuncts owned by
             # the null-preserving side below the join first (for LEFT, a
-            # predicate over left columns only commutes with the join)
+            # predicate over left columns only commutes with the join) —
+            # UNLESS one side binds a >HBM chunked scan and the join fits
+            # one of the multi-pass streamed shapes, in which case the
+            # join defers INTO the streamed graph (_OuterProbe /
+            # _OuterBuild) instead of materializing the chunked side whole
             lp, lj, ls = self._flatten_from(
-                from_.left, where if from_.kind == "left" else None)
+                from_.left, where if from_.kind == "left" else None,
+                top=False)
+            conjs = ([h for c in self._split_conjuncts(from_.condition)
+                      for h in self._hoist_or_conjuncts(c)]
+                     if from_.condition is not None else [])
+            l_chunk = any(isinstance(p, _StreamedScan) for p in lp)
+            if from_.kind == "left" and l_chunk and conjs and \
+                    not os.environ.get("NDS_TPU_NO_PK_GATHER"):
+                # mechanism (b1): chunked scan on the PRESERVED side.
+                # Leave WHERE alone — left-side filters push down inside
+                # the streamed graph; conjuncts over probe columns apply
+                # after the per-chunk gather (_join_parts_outer).
+                rp, rj, rs = self._flatten_from(from_.right, top=False)
+                if self._probe_eligible(conjs, lp, rp, rj, rs):
+                    return (lp + [_OuterProbe(rp[0], from_.condition,
+                                              conjs, rs[0])],
+                            lj, ls + [rs[0]])
+                # ineligible after flattening: today's materialize path,
+                # reusing the already-flattened right side
+                lw = self._consume_pushable(where, lp)
+                left = self._join_parts(lp, lj, lw, ls)
+                right = self._join_parts(rp, rj, [], rs)
+                right_src = rs[0] if len(rs) == 1 else None
+                joined = self._binary_join(left, right, from_.kind,
+                                           from_.condition,
+                                           right_src=right_src)
+                return [joined], [], [None]
             lw = self._consume_pushable(where, lp) \
                 if from_.kind == "left" else []
             left = self._join_parts(lp, lj, lw, ls)
             rp, rj, rs = self._flatten_from(
-                from_.right, where if from_.kind == "right" else None)
+                from_.right, where if from_.kind == "right" else None,
+                top=False)
+            if from_.kind == "left" and top and conjs and \
+                    self._build_eligible(conjs, lp, rp, rj, where):
+                # mechanism (b2): chunked scan on the NULL-INTRODUCING
+                # side — the materialized left side becomes the BUILD
+                # operand of the streamed graph; extras emit at
+                # materialize time from the unmatched-key accumulator
+                build_src = ls[0] if len(ls) == 1 else None
+                return ([rp[0], _OuterBuild(left, from_.condition, conjs,
+                                            build_src)],
+                        [], [rs[0], None])
             rw = self._consume_pushable(where, rp) \
                 if from_.kind == "right" else []
             right = self._join_parts(rp, rj, rw, rs)
@@ -429,6 +545,74 @@ class Planner:
                                        from_.condition, right_src=right_src)
             return [joined], [], [None]
         raise ExecError(f"unsupported FROM clause {type(from_).__name__}")
+
+    def _probe_eligible(self, conjs, lp, rp, rj, rs) -> bool:
+        """Mechanism (b1) shape test: the right side must be one pristine
+        device scan whose ON keys are exactly its declared (composite)
+        primary key, every ON conjunct a plain cross-side equi pair — the
+        shape the per-chunk gather serves with zero steady-state syncs
+        (composite keys must be numeric to pack, mirroring
+        ``_pk_gather_plan``). Mirrored by ``exec_audit._deferred_left``."""
+        from nds_tpu.schema import COMPOSITE_PRIMARY_KEYS, PRIMARY_KEYS
+        if len(rp) != 1 or rj or not rs or rs[0] is None or \
+                not isinstance(rp[0], DeviceTable):
+            return False
+        lcols = set()
+        for p in lp:
+            lcols |= set(p.column_names)
+        rcols = set(rp[0].column_names)
+        rkeys = []
+        for c in conjs:
+            if self._has_subquery(c):
+                return False
+            pair = self._equi_pair(c, lcols, rcols)
+            if pair is None:
+                return False
+            rkeys.append(pair[1])
+        pk = COMPOSITE_PRIMARY_KEYS.get(rs[0])
+        if pk is None and rs[0] in PRIMARY_KEYS:
+            pk = (PRIMARY_KEYS[rs[0]],)
+        if pk is None or {k.split(".")[-1] for k in rkeys} != set(pk):
+            return False
+        if len(pk) > 1 and any(
+                rp[0][k].kind in ("str", "f64") or
+                rp[0][k].kind.startswith("dec") for k in rkeys):
+            return False                 # composite pack is int-only
+        return True
+
+    def _build_eligible(self, conjs, lp, rp, rj, where) -> bool:
+        """Mechanism (b2) shape test: single chunked scan on the right,
+        single device part on the left (the build side), plain equi ON,
+        and NO remaining WHERE conjunct at all — post-join structure
+        (including a ref-less ``1 = 0``) would need the extras (emitted
+        only at materialize) to flow through it. The caller additionally
+        requires the join to be the SELECT's whole FROM (``top``): a
+        parent join would wrap the deferral the same way. Mirrored by
+        ``exec_audit._deferred_left``."""
+        if len(rp) != 1 or rj or not isinstance(rp[0], _StreamedScan):
+            return False
+        if len(lp) != 1 or any(isinstance(p, (_StreamedScan, _OuterProbe,
+                                              _OuterBuild)) for p in lp):
+            return False
+        if where:
+            return False
+        lcols = set(lp[0].column_names)
+        rcols = set(rp[0].column_names)
+        for c in conjs:
+            if self._has_subquery(c) or \
+                    self._equi_pair(c, lcols, rcols) is None:
+                return False
+        return True
+
+    def _refs_touch(self, e, cols) -> bool:
+        """True when any column reference of ``e`` resolves in ``cols``.
+        Subquery-bearing expressions always touch (their inner scopes are
+        not walked, so the conservative answer keeps them post-join —
+        WHERE semantics make post-join evaluation always correct)."""
+        if self._has_subquery(e):
+            return True
+        return any(self._resolve_name(r, cols) is not None
+                   for r in self._column_refs(e))
 
     def _consume_pushable(self, where, parts):
         """Remove and return the conjuncts of ``where`` (in place) whose
@@ -1061,17 +1245,30 @@ class Planner:
             # eager fallbacks (which never drove the pipeline at all)
             eager_span = "stream.overflow-rerun" \
                 if reason == "bound-bucket overflow" else "stream.eager"
+            builds = [p for p in parts if isinstance(p, _OuterBuild)]
+            bitmaps = None
             with _obs.span(eager_span,
                            reason=reason or "replay-nested"):
                 for chunk in parts[keep].device_chunks(self):
                     n_chunks += 1
                     sub = list(parts)
                     sub[keep] = chunk
-                    out = self._join_parts(sub, join_preds, where_conjuncts,
-                                           list(sources))
+                    with E.outer_match_collector() as omc:
+                        out = self._join_parts(sub, join_preds,
+                                               where_conjuncts,
+                                               list(sources))
+                    if builds:
+                        # OR each chunk's matched-build-row masks: the
+                        # outer extras (unmatched across EVERY chunk)
+                        # append once, after the loop
+                        bitmaps = list(omc.masks) if bitmaps is None else \
+                            [a | b for a, b in zip(bitmaps, omc.masks)]
                     if E.count_bound(out.nrows) or not outs:
                         outs.append(out)
                 result = E.concat_tables(outs) if len(outs) > 1 else outs[0]
+                if builds and bitmaps is not None:
+                    result = self._append_outer_extras(result, builds,
+                                                       bitmaps)
             if reason is not None:
                 # recorded AFTER the loop: the event's syncs charge the whole
                 # eager path (failed compile attempt + per-chunk loop), which
@@ -1082,6 +1279,123 @@ class Planner:
                                     E.sync_count() - syncs0, "eager", reason)
                 _obs.annotate(path="eager", chunks=n_chunks, reason=reason)
             return result
+
+    def _append_outer_extras(self, result, builds, bitmaps):
+        """Eager-loop twin of the pipeline's materialize-time extras:
+        null-extended unmatched build rows of every deferred outer-build
+        join, appended once after the chunk union."""
+        parts = [result]
+        for w, bm in zip(builds, bitmaps):
+            miss = ~bm & E.live_mask(w.table.plen, w.table.nrows)
+            n_miss = E.host_sync(jnp.sum(miss))
+            if not n_miss:
+                continue
+            idx = E.compact_indices(miss, n_miss)
+            parts.append(outer_extras_table(w.table, idx, n_miss, result))
+        return E.concat_tables(parts) if len(parts) > 1 else result
+
+    def _join_parts_outer(self, parts, join_preds, where_conjuncts,
+                          sources, outer_idx):
+        """One multi-pass outer-join step: runs per chunk inside the
+        streamed pipeline (the chunk slot is a bound DeviceTable here) and
+        per chunk on the eager loop. Joins the parts connected to the
+        chunk side by outer-free conjuncts first, applies each deferred
+        LEFT join, then joins any leftover parts/conjuncts that needed
+        the probe columns (q93: ``reason`` joins the returns side of the
+        gather). WHERE semantics make the post split always correct —
+        deferring a conjunct past the outer join only delays a filter."""
+        wrappers = [parts[i] for i in outer_idx]
+        inner = [p for i, p in enumerate(parts) if i not in outer_idx]
+        inner_src = [s for i, s in enumerate(sources) if i not in outer_idx]
+        outer_cols = set()
+        for w in wrappers:
+            outer_cols |= set(w.column_names)
+        conjuncts = list(join_preds) + list(where_conjuncts)
+        post = [c for c in conjuncts if self._refs_touch(c, outer_cols)]
+        pre = [c for c in conjuncts if not any(c is x for x in post)]
+        # union-find the inner parts along pre-conjunct ownership; the
+        # components providing the wrappers' ON columns join BEFORE the
+        # deferred joins, everything else after
+        groups = list(range(len(inner)))
+
+        def find(i):
+            while groups[i] != i:
+                groups[i] = groups[groups[i]]
+                i = groups[i]
+            return i
+
+        part_colsets = [set(p.column_names) for p in inner]
+
+        def owners_of(e):
+            return [i for i, cs in enumerate(part_colsets)
+                    if self._refs_touch(e, cs)]
+
+        for c in pre:
+            own = owners_of(c)
+            for o in own[1:]:
+                groups[find(own[0])] = find(o)
+        anchors = set()
+        for w in wrappers:
+            for c in w.conjuncts:
+                for o in owners_of(c):
+                    anchors.add(find(o))
+        if not anchors and inner:
+            anchors = {find(0)}
+        pre_idx = [i for i in range(len(inner)) if find(i) in anchors]
+        post_idx = [i for i in range(len(inner)) if find(i) not in anchors]
+        pre_set = set(pre_idx)
+        pre_here = [c for c in pre
+                    if set(owners_of(c)) <= pre_set]
+        leftover = [c for c in conjuncts
+                    if not any(c is x for x in pre_here)]
+        out = self._join_parts(
+            [inner[i] for i in pre_idx],
+            [c for c in join_preds if any(c is x for x in pre_here)],
+            [c for c in where_conjuncts if any(c is x for x in pre_here)],
+            [inner_src[i] for i in pre_idx])
+        for w in wrappers:
+            out = self._apply_outer(out, w)
+        if post_idx or leftover:
+            out = self._join_parts(
+                [out] + [inner[i] for i in post_idx], [], leftover,
+                [None] + [inner_src[i] for i in post_idx])
+        return out
+
+    def _apply_outer(self, left: DeviceTable, w) -> DeviceTable:
+        """Apply one deferred LEFT join to a (per-chunk) joined table."""
+        if isinstance(w, _OuterProbe):
+            # preserved chunk side: PK gather against the whole probe
+            # table — sync-free, keeps the chunk's physical rows, misses
+            # null-extend in place (_binary_join's gather arm)
+            return self._binary_join(left, w.table, "left", w.condition,
+                                     right_src=w.src)
+        # _OuterBuild: build ⟕ chunk — emit THIS dispatch's matched pairs
+        # through an inner bound-bucket join and register the matched
+        # build rows; the unmatched build rows (the outer extras) emit
+        # ONCE at materialize time from the OR of every dispatch's mask
+        build = w.table
+        lcols = set(left.column_names)
+        bcols = set(build.column_names)
+        lkeys, bkeys = [], []
+        for c in w.conjuncts:
+            pair = self._equi_pair(c, lcols, bcols)
+            if pair is None:
+                raise ExecError("outer-build join requires plain equi keys")
+            lkeys.append(left[pair[0]])
+            bkeys.append(build[pair[1]])
+        # probe FROM the chunk side: the pair bucket stays chunk-sized
+        l_idx, r_idx, n_pairs, _, _, _, _ = E.join_indices(
+            lkeys, bkeys, "inner", n_left=left.nrows, n_right=build.nrows)
+        matched = jnp.zeros(build.plen, dtype=bool).at[r_idx].set(
+            True, mode="drop")
+        E.stream_outer_matched(matched)
+        cols = dict(E.gather_table_rows(build, r_idx, n_pairs).columns)
+        for n, c in E.gather_table_rows(left, l_idx, n_pairs).columns.items():
+            # chunk-side columns must be NULLABLE in the output template:
+            # the extras rows null-extend them at materialize time
+            cols.setdefault(n, Column(c.kind, c.data, c.valid_mask(),
+                                      c.dict_values))
+        return DeviceTable(cols, n_pairs)
 
     def _join_parts(self, parts, join_preds, where_conjuncts, sources=None):
         """Join-graph execution: push single-table predicates down, then join
@@ -1096,6 +1410,11 @@ class Planner:
         if any(isinstance(p, _StreamedScan) for p in parts):
             return self._stream_join_parts(parts, join_preds,
                                            where_conjuncts, sources)
+        outer_idx = [i for i, p in enumerate(parts)
+                     if isinstance(p, (_OuterProbe, _OuterBuild))]
+        if outer_idx:
+            return self._join_parts_outer(parts, join_preds, where_conjuncts,
+                                          sources, outer_idx)
         sources = list(sources)
         conjuncts = list(join_preds) + list(where_conjuncts)
         # split into single-table filters / equi edges / complex residual
@@ -2054,10 +2373,17 @@ class Planner:
         if isinstance(from_, A.TableRef):
             alias = (from_.alias or from_.name).lower()
             try:
-                t = self._lookup_table(from_.name)
+                cols = self._lookup_table(from_.name).column_names
             except ExecError:
-                return out
-            for c in t.column_names:
+                # the traced per-chunk planner has an EMPTY catalog; its
+                # correlation analysis must still resolve subquery scopes
+                # exactly like the record phase did, so the pipeline seeds
+                # a NAMES-ONLY snapshot of the record-time catalog
+                nc = getattr(self, "name_catalog", None)
+                cols = (nc or {}).get(from_.name.lower())
+                if cols is None:
+                    return out
+            for c in cols:
                 out.add(f"{alias}.{c.split('.')[-1].lower()}")
         elif isinstance(from_, A.SubqueryRef):
             body = from_.query.body
@@ -2087,6 +2413,65 @@ class Planner:
             else:
                 names.append(f"col{i}")
         return names
+
+    # -------------------------------------------- subquery residuals
+    # Multi-pass streaming, mechanism (a): a subquery nested in a streamed
+    # graph's conjuncts is CHUNK-INVARIANT once decorrelated (its plan
+    # references only its own tables), so the pipeline streams the inner
+    # query FIRST — eagerly, outside the recording, with its own compiled
+    # pipeline if the inner binds a chunked scan — into a device-resident
+    # residual, then records/drives the outer scan with the residual as an
+    # ordinary device operand. Two compiled pipelines, one materializing
+    # sync each, chained without a host round trip per chunk.
+
+    def _residual_key(self, payload) -> str:
+        return payload[0] + "|" + "|".join(
+            expr_key(x) if x is not None else "-" for x in payload[1:])
+
+    def _plan_residual(self, payload):
+        """Plan one subquery residual with the real planner/catalog."""
+        if payload[0] == "query":
+            return self.query(payload[1])
+        # ("exists_inner", from_, where): correlated EXISTS with a
+        # non-equality residual (q16/q94) — the inner join graph,
+        # stripped of its correlation conjuncts, materialized whole
+        _tag, from_, where = payload
+        parts, preds, srcs = self._flatten_from(from_)
+        return self._join_parts(parts, preds,
+                                self._split_conjuncts(where), srcs)
+
+    def _residual_table(self, payload) -> DeviceTable:
+        """The device-resident residual of one chunk-invariant subquery,
+        planned at most once per statement. Inside a record phase the
+        inner plan runs under ``ops.suspend_stream_record()`` — its host
+        reads must never interleave with the outer recording, and freed
+        of the stream-bounds guard it may sync (once) or stream through
+        its own compiled pipeline. Inside the traced per-chunk program
+        the registry is pre-seeded from the pipeline's operands; a miss
+        there means the pipeline cannot serve the statement
+        (StreamSyncError => eager fallback)."""
+        key = self._residual_key(payload)
+        hit = self._subquery_residuals.get(key)
+        if hit is None:
+            if E.stream_bounds_on():
+                if E.replay_mode() == "replay":
+                    raise E.StreamSyncError(
+                        f"unplanned subquery residual {key[:80]}")
+                with E.suspend_stream_record():
+                    rt = E.resolve_table(self._plan_residual(payload))
+            else:
+                # outside a pipeline the residual stays LAZY (a q9-class
+                # projection subquery must keep its no-sync broadcast
+                # arm); the registry still dedupes repeated subqueries
+                # and caches across eager chunks
+                rt = self._plan_residual(payload)
+            hit = (payload, rt)
+            self._subquery_residuals[key] = hit
+        if self._residuals_touched is not None and \
+                E.stream_bounds_on() and E.replay_mode() == "record" and \
+                all(k != key for (k, _p, _t) in self._residuals_touched):
+            self._residuals_touched.append((key, hit[0], hit[1]))
+        return hit[1]
 
     def _find_correlation(self, q: A.Query, ctx: EvalCtx):
         """Detect equality correlation between a subquery and the outer row.
@@ -2142,7 +2527,7 @@ class Planner:
         n = ctx.table.plen
         found = self._find_correlation(e.query, ctx)
         if found is None:
-            t = self.query(e.query)
+            t = self._residual_table(("query", e.query))
             val = E.count_int(t.nrows) > 0
             res = Column("bool", jnp.full(n, val, dtype=bool))
             return X.logical_not(res) if e.negated else res
@@ -2155,9 +2540,8 @@ class Planner:
             if sel.group_by or sel.having:
                 raise ExecError("correlated EXISTS with residual predicate "
                                 "and grouping unsupported")
-            parts, preds, srcs = self._flatten_from(sel.from_)
-            inner_t = self._join_parts(parts, preds,
-                                       self._split_conjuncts(sel.where), srcs)
+            inner_t = self._residual_table(("exists_inner", sel.from_,
+                                            sel.where))
             lkeys = [self.eval_expr(outer, ctx) for outer, _ in corr]
             rkeys = [self.eval_expr(inner, EvalCtx(inner_t))
                      for _, inner in corr]
@@ -2179,7 +2563,7 @@ class Planner:
                        for i, (_, inner) in enumerate(corr)]
         sub = A.Query(A.Select(inner_items, sel.from_, sel.where, sel.group_by,
                                sel.having, True), [], None, [])
-        rt = self.query(sub)
+        rt = self._residual_table(("query", sub))
         lkeys = [self.eval_expr(outer, ctx) for outer, _ in corr]
         rkeys = [rt[c] for c in rt.column_names]
         mask = E.semi_join_mask(lkeys, rkeys, negate=e.negated,
@@ -2189,7 +2573,7 @@ class Planner:
     def _eval_in_subquery(self, e: A.InSubquery, ctx: EvalCtx) -> Column:
         found = self._find_correlation(e.query, ctx)
         if found is None:
-            rt = self.query(e.query)
+            rt = self._residual_table(("query", e.query))
             rcol = rt[rt.column_names[0]]
             lcol = self.eval_expr(e.expr, ctx)
             lcol2, rcol2 = self._coerce_pair(lcol, rcol)
@@ -2210,7 +2594,7 @@ class Planner:
                                   for i, (_, inner) in enumerate(corr)]
         sub = A.Query(A.Select(items, sel.from_, sel.where, sel.group_by,
                                sel.having, True), [], None, [])
-        rt = self.query(sub)
+        rt = self._residual_table(("query", sub))
         rcols = [rt[c] for c in rt.column_names]
         lcols = [self.eval_expr(e.expr, ctx)] + \
             [self.eval_expr(outer, ctx) for outer, _ in corr]
@@ -2241,7 +2625,7 @@ class Planner:
         n = ctx.table.plen
         found = self._find_correlation(e.query, ctx)
         if found is None:
-            rt = self.query(e.query)
+            rt = self._residual_table(("query", e.query))
             col = rt[rt.column_names[0]]
             if isinstance(rt.nrows, E.DeviceCount):
                 # LAZY scalar: broadcast row 0 with device-side validity
@@ -2285,7 +2669,7 @@ class Planner:
         sub = A.Query(A.Select(items, sel.from_, sel.where,
                                A.GroupingSets("plain", [gexprs], gexprs),
                                sel.having, False), [], None, [])
-        rt = self.query(sub)
+        rt = self._residual_table(("query", sub))
         val_col = rt[rt.column_names[0]]
         rkeys = [rt[c] for c in rt.column_names[1:1 + len(corr)]]
         lkeys = [self.eval_expr(outer, ctx) for outer, _ in corr]
@@ -2297,8 +2681,13 @@ class Planner:
         # subquery was not scalar per outer row
         hits = jnp.zeros(n, dtype=jnp.int32).at[l_idx].add(1, mode="drop")
         # pad pairs drop out of the scatter, so max(hits) alone detects a
-        # non-scalar subquery; one counted, batch-draining host read
-        if E.DeviceCount(jnp.max(hits), n).to_int() > 1:
+        # non-scalar subquery; one counted, batch-draining host read.
+        # Inside the compiled per-chunk program the check rides the
+        # overflow channel instead (a flagged chunk reruns eagerly, where
+        # this arm raises the real error — bit-for-bit semantics)
+        if E.stream_bounds_on():
+            E.stream_overflow(jnp.max(hits) > 1)
+        elif E.DeviceCount(jnp.max(hits), n).to_int() > 1:
             raise ExecError("correlated scalar subquery returned more than one "
                             "row per outer row")
         data = jnp.zeros(n, dtype=val_col.data.dtype)
@@ -2314,7 +2703,7 @@ class Planner:
             return self._eval_in_subquery(A.InSubquery(e.expr, e.query, False), ctx)
         if e.op == "<>" and e.quantifier == "all":
             return self._eval_in_subquery(A.InSubquery(e.expr, e.query, True), ctx)
-        rt = self.query(e.query)
+        rt = self._residual_table(("query", e.query))
         col = rt[rt.column_names[0]]
         lhs = self.eval_expr(e.expr, ctx)
         if E.count_int(rt.nrows) == 0:

@@ -729,13 +729,11 @@ def exec_audit(sql, streamed=("store_sales",)):
 
 def test_exec_audit_ab_templates_classification():
     """The A/B templates pinned by test_synccount: the static auditor
-    must predict the exact path the runtime takes — compiled-stream for
-    the chunk-pipeline shapes (including the three bare scans the memory
-    proof reclassified from `accumulator-overflow`) and eager-fallback
-    with the subquery-residual reason for the IN-subquery template (its
-    residual needs the catalog, which the chunk-invariant program must
-    not close over) — with every compiled scan's steady-state bound
-    inside the streamed budget."""
+    must predict the exact path the runtime takes — every template now
+    streams compiled (the multi-pass conversions cleared the IN-subquery
+    fallback too), with every compiled scan's steady-state bound inside
+    the streamed budget, and the converted shapes carrying their
+    mechanism tags."""
     from nds_tpu.analysis.exec_audit import (CLASS_COMPILED, CLASS_EAGER,
                                              SYNC_BUDGET)
     from test_synccount import _STREAM_AB_QUERIES
@@ -749,8 +747,14 @@ def test_exec_audit_ab_templates_classification():
             assert r.sync_bound is not None and r.sync_bound <= SYNC_BUDGET
             for s in r.scans:
                 assert s.compiled and s.gate_bound <= SYNC_BUDGET
-    eager = reports[[m for _q, m in _STREAM_AB_QUERIES].index(False)]
-    assert "subquery-residual" in eager.reasons
+    mechs = [set(m for s in r.scans for m in s.mechanisms)
+             for r in reports]
+    # ab4 (IN subquery), ab10 (outer gather), ab11 (outer build),
+    # ab13 (NOT IN: recorded scalar)
+    assert "streamed-subquery" in mechs[3]
+    assert "outer-gather" in mechs[9]
+    assert "outer-build" in mechs[10]
+    assert {"streamed-subquery", "recorded-scalar"} <= mechs[12]
 
 
 def test_exec_audit_device_resident():
@@ -795,16 +799,40 @@ def test_exec_audit_reason_codes():
                          mem_model=MemModel(acc_ceiling=1 << 10))
     r = capped.audit_sql("select ss_item_sk from store_sales")
     assert r.reasons == ("accumulator-overflow",)
-    # bare scan on an outer-join side: extras semantics materialize the
-    # whole side
+    # chunked scan on the null-introducing side of a LEFT join: the
+    # multi-pass outer-build conversion (unmatched-key accumulator,
+    # extras at materialize) streams it compiled
     r = exec_audit("select d_year, ss_item_sk from date_dim left join "
                    "store_sales on d_date_sk = ss_sold_date_sk")
-    assert r.reasons == ("outer-join-extras",)
-    # ...but a filtered side of an outer join streams compiled
+    assert r.classification == "compiled-stream"
+    assert any("outer-build" in s.mechanisms for s in r.scans)
+    # ...but a remaining WHERE conjunct over either side needs the extras
+    # to flow through post-join structure: ineligible, the side
+    # materializes whole and outer-join-extras still fires
+    r = exec_audit("select d_year, ss_item_sk from date_dim left join "
+                   "store_sales on d_date_sk = ss_sold_date_sk "
+                   "where ss_item_sk > 5 or d_year = 1999")
+    assert "outer-join-extras" in r.reasons
+    # chunked scan PRESERVED with ON keys that do NOT cover the right
+    # side's primary key: no sync-free per-chunk gather exists, the left
+    # side materializes whole — outer-join-extras
+    r = exec_audit("select ss_item_sk, i_brand_id from store_sales "
+                   "left join item on ss_item_sk = i_brand_id")
+    assert "outer-join-extras" in r.reasons
+    # chunked scan PRESERVED with ON keys = the right side's PK: the
+    # outer-gather conversion rides the join into the per-chunk program
     r = exec_audit("select ss_item_sk, i_brand_id from store_sales "
                    "left join item on ss_item_sk = i_item_sk "
                    "where ss_ext_sales_price > 9900")
     assert r.classification == "compiled-stream"
+    assert any("outer-gather" in s.mechanisms for s in r.scans)
+    # subquery conjunct: formerly the canonical subquery-residual eager
+    # fallback — now pre-planned into a device residual, compiled
+    r = exec_audit("select count(*) c from store_sales "
+                   "where ss_sold_date_sk in "
+                   "(select d_date_sk from date_dim where d_moy = 11)")
+    assert r.classification == "compiled-stream" and not r.reasons
+    assert any("streamed-subquery" in s.mechanisms for s in r.scans)
 
 
 def test_exec_audit_cte_shadowing_not_streamed():
@@ -911,9 +939,12 @@ def test_mem_audit_corpus_finite_and_deterministic():
     assert reports_to_findings(reports) == []
     partitioned = {r.query: s for r in reports for s in r.scans
                    if s.partitions > 1}
+    # query54 joined the set when its subquery conjuncts became
+    # residual-planned filters: the graph turned provable and its
+    # whole-statement bound is past capacity, so it decomposes too
     assert sorted(partitioned) == \
         ["query17", "query24_part1", "query24_part2", "query25",
-         "query29", "query64", "query72"]
+         "query29", "query54", "query64", "query72"]
     cap = hbm_capacity_bytes()
     for q, s in partitioned.items():
         assert s.provable and s.part_bytes <= cap, (q, s)
@@ -947,12 +978,13 @@ def test_mem_audit_bound_rules():
     (s,) = r.scans
     assert s.provable and s.fanout_k == 1
     assert r.out_rows == 1               # keyless aggregate: one row
-    # a subquery conjunct makes the multiplicity unprovable (the runtime
-    # trace diverges there: eager loop)
+    # a subquery conjunct is a residual-planned FILTER (multi-pass
+    # streaming): it neither grows rows nor breaks the proof, so the
+    # scan keeps the bare-scan bound
     r = mem_audit("""
         select count(*) c from store_sales where ss_sold_date_sk in
         (select d_date_sk from date_dim where d_moy = 11)""")
-    assert r.scans and not r.scans[0].provable
+    assert r.scans and r.scans[0].provable and r.scans[0].fanout_k == 0
     # unconnected parts (cartesian layout): unprovable too
     r = mem_audit("select count(*) c from store_sales, item "
                   "where ss_ext_sales_price > 0 and i_brand_id = 1")
@@ -1219,10 +1251,34 @@ def test_lint_cli_stream_report():
     r = _run_lint("--stream-report")
     assert r.returncode == 0, r.stdout + r.stderr
     assert "per-template execution-path classification" in r.stdout
-    for klass in ("compiled-stream", "eager-fallback", "device-resident"):
+    for klass in ("compiled-stream", "device-resident"):
         assert klass in r.stdout
-    # the report is the widening worklist: eager scans carry reason codes
-    assert "subquery-residual" in r.stdout
+    # multi-pass streaming: the report names the conversion mechanisms
+    # that serve the formerly-eager statements
+    for mech in ("streamed-subquery", "outer-gather", "outer-build"):
+        assert mech in r.stdout
+    # --format json: the machine-readable report carries the mechanism
+    # field per scan, stdout stays ONE parseable document
+    r = _run_lint("--stream-report", "--format", "json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    scans = [s for e in doc["stream_report"] for s in e["scans"]]
+    assert any("streamed-subquery" in s["mechanisms"] for s in scans)
+    assert any("outer-gather" in s["mechanisms"] for s in scans)
+
+
+def test_stream_report_classification_counts_pinned():
+    """The corpus classification is a tier-1 contract, pinned the same
+    way baseline.json is: --stream-report drift (a statement silently
+    reclassifying to eager-fallback, or a conversion quietly lost) must
+    fail loudly, not surface months later in an SF10 campaign. Update
+    these counts ONLY together with the matching engine/audit change —
+    the lockstep rule."""
+    from collections import Counter
+
+    from nds_tpu.analysis.exec_audit import audit_exec_corpus
+    counts = Counter(r.classification for r in audit_exec_corpus())
+    assert counts == {"compiled-stream": 96, "device-resident": 7}, counts
 
 
 def test_lint_cli_mem_report():
@@ -1230,10 +1286,11 @@ def test_lint_cli_mem_report():
     assert r.returncode == 0, r.stdout + r.stderr
     assert "per-statement peak-HBM byte bounds" in r.stdout
     assert "capacity model" in r.stdout
-    # provable accumulators print their row bound; unprovable scans name
-    # the eager loop
+    # provable accumulators print their row bound; the multi-pass
+    # conversions left no unprovable corpus scan (subquery conjuncts are
+    # residual-planned filters now)
     assert "rows, k=" in r.stdout
-    assert "unprovable (eager loop)" in r.stdout
+    assert "unprovable (eager loop)" not in r.stdout
     # --format json keeps stdout a single document with the report inline
     r = _run_lint("--mem-report", "--format", "json")
     assert r.returncode == 0, r.stdout + r.stderr

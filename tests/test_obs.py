@@ -204,10 +204,15 @@ def chunked_trace(tmp_path):
     tdir = tmp_path / "traces"
     tdir.mkdir()
     out = {}
-    # queries[0] pins the compiled pipeline; queries[3] is the IN-subquery
-    # automatic eager fallback
+    # queries[0] pins the compiled pipeline. The IN-subquery template
+    # streams compiled now (multi-pass residuals), so the canonical
+    # automatic eager fallback is a CARTESIAN layout in the streamed
+    # graph — unconnected parts lay out their pair expansion from host
+    # row counts, which is never chunk-invariant.
+    fallback_sql = ("select count(*) c from store_sales, item "
+                    "where ss_ext_sales_price > 9990 and i_brand_id = 1")
     for label, (sql, _must) in (("compiled", queries[0]),
-                                ("fallback", queries[3])):
+                                ("fallback", (fallback_sql, False))):
         rows = s.sql(sql).collect()
         assert rows
         records = obs_trace.drain_spans()
@@ -280,6 +285,13 @@ def test_trace_report_aggregates_dir(chunked_trace, capsys):
     assert "top host-sync sites" in out
     assert "eager-fallback cost by reason" in out
     assert "trace diverged" in out or "not chunk-invariant" in out
+    # the ranking is PRICED: each fallback line projects the savings of a
+    # conversion from this run's own compiled per-chunk drive cost
+    assert "projected" in out and "saved" in out
+    fallback_lines = [ln for ln in out.splitlines()
+                      if "not chunk-invariant" in ln
+                      or "trace diverged" in ln]
+    assert fallback_lines and all("saved" in ln for ln in fallback_lines)
 
 
 def test_span_syncs_match_stream_event(chunked_trace):

@@ -358,3 +358,157 @@ def test_session_stream_threshold(monkeypatch, tmp_path):
     assert isinstance(s.catalog["sales"], ChunkedTable)
     r = s.sql("select count(*), sum(s_qty) from sales").collect()
     assert r[0][0] == 3000
+
+
+# ---------------------------------------------------------------------------
+# multi-pass streaming (subquery residuals, deferred outer joins, strict
+# failure mode)
+# ---------------------------------------------------------------------------
+
+
+def test_stream_strict_reraises_engine_bugs(monkeypatch):
+    """NDS_TPU_STREAM_STRICT=1: a record/trace failure that is NOT one of
+    the two legitimate routing exceptions (StreamSyncError /
+    ReplayMismatch) must RE-RAISE instead of hiding inside an eager
+    fallback; without strict mode the fallback reason must carry the
+    exception class so the event is auditable."""
+    from nds_tpu.engine import stream as S
+    from nds_tpu.listener import drain_stream_events
+
+    sales, items, dates = _tables(1500)
+    sql = ("select s_item, sum(s_qty) q from sales, items "
+           "where s_item = i_item group by s_item order by s_item")
+
+    def boom(*a, **k):
+        raise ValueError("injected engine bug")
+
+    def run():
+        s = Session()
+        s.create_temp_view("items", items, base=True)
+        s.create_temp_view("sales", ChunkedTable(sales, chunk_rows=512),
+                           base=True)
+        drain_stream_events()
+        return s, s.sql(sql)
+
+    # the pipeline's run phase trips the injected bug (record succeeds;
+    # the StreamPipeline.run entry raises like a trace-time ValueError)
+    monkeypatch.setattr(S.StreamPipeline, "run", boom)
+    monkeypatch.delenv("NDS_TPU_STREAM_STRICT", raising=False)
+    s, res = run()
+    rows = res.collect()
+    events = drain_stream_events()
+    assert rows, "fallback must still produce the result"
+    assert [e.path for e in events] == ["eager"]
+    assert "ValueError" in events[0].reason, events[0].reason
+    monkeypatch.setenv("NDS_TPU_STREAM_STRICT", "1")
+    with pytest.raises(ValueError, match="injected engine bug"):
+        run()[1].collect()
+
+
+def test_outer_build_extras_all_unmatched(monkeypatch):
+    """Outer-build edge: NO build row matches any chunk — the entire
+    output is extras, emitted at materialize time from the unmatched-key
+    accumulator, null-extended on the chunk side."""
+    rng = np.random.default_rng(5)
+    n = 3000
+    sales = pa.table({
+        "s_item": pa.array(rng.integers(1, 80, n), pa.int64()),
+        "s_tick": pa.array(np.arange(n), pa.int64()),
+        "s_qty": pa.array(rng.integers(1, 50, n), pa.int64()),
+    })
+    # returns keys entirely OUTSIDE the sales key range: zero matches
+    returns = pa.table({
+        "r_item": pa.array(np.arange(900, 950), pa.int64()),
+        "r_tick": pa.array(np.arange(50), pa.int64()),
+        "r_amt": pa.array(rng.integers(1, 9, 50), pa.int64()),
+    })
+    from nds_tpu.listener import drain_stream_events
+    s = Session()
+    s.create_temp_view("returns", returns, base=True)
+    s.create_temp_view("sales", ChunkedTable(sales, chunk_rows=512),
+                       base=True)
+    drain_stream_events()
+    sql = ("select r_item, r_amt, s_qty from returns left join sales "
+           "on r_item = s_item and r_tick = s_tick "
+           "order by r_item")
+    rows = s.sql(sql).collect()
+    events = drain_stream_events()
+    assert [e.path for e in events] == ["compiled"]
+    assert events[0].rows == 0           # the accumulator kept no pairs
+    assert len(rows) == 50               # ...but every build row came out
+    assert all(r[2] is None for r in rows), "extras must null-extend"
+
+
+def test_subquery_residual_reused_across_eager_chunks():
+    """The residual registry also serves the EAGER loop: an escape-hatch
+    run must plan each distinct subquery once per statement, not once per
+    chunk (results identical either way)."""
+    import os
+
+    sales, items, dates = _tables(2000)
+    sql = ("select count(*) c from sales where s_item in "
+           "(select i_item from items where i_cat = 'cat2')")
+
+    def run():
+        s = Session()
+        s.create_temp_view("items", items, base=True)
+        s.create_temp_view("sales", ChunkedTable(sales, chunk_rows=256),
+                           base=True)
+        return s.sql(sql).collect()
+
+    compiled = run()
+    old = os.environ.get("NDS_TPU_STREAM_EXEC")
+    os.environ["NDS_TPU_STREAM_EXEC"] = "eager"
+    try:
+        eager = run()
+    finally:
+        if old is None:
+            del os.environ["NDS_TPU_STREAM_EXEC"]
+        else:
+            os.environ["NDS_TPU_STREAM_EXEC"] = old
+    assert compiled == eager and compiled[0][0] > 0
+
+
+def test_outer_build_not_deferred_under_parent_join():
+    """Review regression: SQL left-assoc makes ``returns ⟕ sales JOIN
+    dates`` drop every unmatched returns row (its sales-side date is
+    NULL, so the parent inner join filters it). The outer-build deferral
+    must NOT fire under a parent join — materialize-time extras cannot
+    flow through post-join structure — and the chunked plan must match
+    the resident one bit for bit."""
+    rng = np.random.default_rng(9)
+    n = 2000
+    sales = pa.table({
+        "s_item": pa.array(rng.integers(1, 60, n), pa.int64()),
+        "s_tick": pa.array(np.arange(n), pa.int64()),
+        "s_date": pa.array(rng.integers(1, 300, n), pa.int64()),
+        "s_qty": pa.array(rng.integers(1, 50, n), pa.int64()),
+    })
+    returns = pa.table({
+        # half the keys land outside the sales tick range: unmatched
+        "r_item": pa.array(rng.integers(1, 60, 80), pa.int64()),
+        "r_tick": pa.array(np.arange(0, 8000, 100), pa.int64()),
+        "r_amt": pa.array(rng.integers(1, 9, 80), pa.int64()),
+    })
+    dates = pa.table({
+        "d_date": pa.array(np.arange(1, 301), pa.int64()),
+        "d_year": pa.array(1998 + np.arange(300) // 100, pa.int64()),
+    })
+    sql = ("select r_item, r_amt, s_qty, d_year from returns "
+           "left join sales on r_item = s_item and r_tick = s_tick "
+           "join dates on s_date = d_date "
+           "order by r_item, r_amt, s_qty")
+    resident = Session()
+    streamed = Session()
+    for s in (resident, streamed):
+        s.create_temp_view("returns", returns, base=True)
+        s.create_temp_view("dates", dates, base=True)
+    resident.create_temp_view("sales", sales, base=True)
+    streamed.create_temp_view("sales", ChunkedTable(sales, chunk_rows=512),
+                              base=True)
+    a = resident.sql(sql).collect()
+    b = streamed.sql(sql).collect()
+    assert a == b
+    # the parent inner join drops unmatched returns rows: no row may
+    # carry a NULL sales side (extras leaking through would)
+    assert all(r[2] is not None for r in b)

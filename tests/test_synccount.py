@@ -201,7 +201,11 @@ def _chunked_star_session(rng, chunk_rows=2048):
     primary key (sr_item_sk, sr_ticket_number) — the fan-out (k=1) join
     shape the partitioned-accumulation templates exercise. 3 rows per
     item keeps the per-chunk pair bucket inside the stream-fanout
-    allowance (default 4), so the fan-out joins stay compiled."""
+    allowance (default 4), so the fan-out joins stay compiled.
+    ss_ticket_number makes (ss_item_sk, ss_ticket_number) a usable
+    composite join target for the multi-pass outer-join templates
+    (store_returns' composite PK on one side, store_sales' on the
+    other)."""
     from nds_tpu.engine.table import ChunkedTable
     n_fact, n_dim = 20_000, 365
     s = Session()
@@ -223,6 +227,8 @@ def _chunked_star_session(rng, chunk_rows=2048):
         "ss_sold_date_sk": pa.array(
             rng.integers(1, n_dim + 40, n_fact), pa.int64()),
         "ss_item_sk": pa.array(rng.integers(1, 230, n_fact), pa.int64()),
+        "ss_ticket_number": pa.array(
+            np.arange(n_fact) % 1200, pa.int64()),
         "ss_ext_sales_price": pa.array(
             rng.integers(1, 10_000, n_fact), pa.int64()),
     }), chunk_rows=chunk_rows), base=True)
@@ -249,10 +255,12 @@ _STREAM_AB_QUERIES = [
     ("""select ss_item_sk, count(*) c, sum(ss_ext_sales_price) s
         from store_sales where ss_ext_sales_price > 5000
         group by ss_item_sk order by ss_item_sk""", True),
-    # IN-subquery residual: not chunk-invariant, falls back eagerly
+    # IN-subquery residual (mechanism a): the inner query pre-plans into
+    # a device-resident residual, so the statement streams COMPILED
+    # (formerly the canonical eager fallback)
     ("""select count(*) c, sum(ss_ext_sales_price) s from store_sales
         where ss_sold_date_sk in
-              (select d_date_sk from date_dim where d_moy = 11)""", False),
+              (select d_date_sk from date_dim where d_moy = 11)""", True),
     # --- bare scans (no filter, no join: the survivor accumulator keeps
     # every chunk row). Formerly `accumulator-overflow` eager fallbacks;
     # the static memory proof (analysis/mem_audit.py) now sizes the
@@ -284,14 +292,61 @@ _STREAM_AB_QUERIES = [
         where ss_item_sk = sr_item_sk and ss_item_sk = i_item_sk
           and sr_return_amt > 50
         group by i_brand_id order by i_brand_id""", True),
+    # --- multi-pass streaming (PR 8): the three eager-fallback
+    # conversions, each run bit-for-bit vs eager and under the forced
+    # partition count like everything above.
+    # (b1) outer-gather: LEFT join with the chunked scan PRESERVED, ON
+    # keys = store_returns' composite PK, plus the q78-class IS NULL
+    # post filter — the join rides INTO the per-chunk program as a
+    # sync-free gather
+    ("""select ss_item_sk, count(*) c from store_sales
+        left join store_returns on ss_item_sk = sr_item_sk
+            and ss_ticket_number = sr_ticket_number
+        where sr_ticket_number is null
+        group by ss_item_sk order by ss_item_sk""", True),
+    # (b2) outer-build: LEFT join with the chunked scan on the
+    # NULL-INTRODUCING side (q5 shape) — matched pairs stream per chunk,
+    # an on-device unmatched-key bitmap accumulates, and the outer
+    # extras emit once at materialize time
+    ("""select sr_item_sk, sr_return_amt, ss_ext_sales_price
+        from store_returns
+        left join store_sales on sr_item_sk = ss_item_sk
+            and sr_ticket_number = ss_ticket_number
+        order by sr_item_sk, sr_return_amt, ss_ext_sales_price""", True),
+    # (a) streamed-subquery CHAIN: the scalar subquery's inner plan scans
+    # the chunked table itself — TWO compiled pipelines, the inner's
+    # residual threading into the outer as a device operand
+    ("""select ss_item_sk, count(*) c from store_sales
+        where ss_sold_date_sk in
+              (select d_date_sk from date_dim where d_moy = 11)
+          and ss_ext_sales_price >
+              (select avg(ss_ext_sales_price) from store_sales)
+        group by ss_item_sk order by ss_item_sk""", True),
+    # (c) recorded chunk-scalar: ANSI NOT IN consults the residual's
+    # null count — a recorded scalar replayed per chunk under a
+    # device-side staleness guard
+    ("""select count(*) c, sum(ss_ext_sales_price) s from store_sales
+        where ss_item_sk not in
+              (select i_item_sk from item where i_brand_id = 1001)""",
+     True),
+    # correlated EXISTS with a non-equality residual (q16/q94 class):
+    # the stripped inner graph pre-plans as an exists_inner residual,
+    # the pair probe runs per chunk under stream bounds
+    ("""select count(*) c from store_sales ss1 where exists (
+            select * from store_returns sr
+            where ss1.ss_item_sk = sr.sr_item_sk
+              and ss1.ss_ticket_number <> sr.sr_ticket_number)""", True),
 ]
 
-# indexes of the fan-out templates above: under a forced partition count
-# these must stream through the PARTITIONED compiled pipeline (the A/B
-# harnesses and test_streamed_compiled_matches_eager assert it)
+# indexes of the templates above that must stream through the
+# PARTITIONED compiled pipeline under a forced partition count (the A/B
+# harnesses and test_streamed_compiled_matches_eager assert it): any
+# graph joining store_returns ON the streamed scan directly. The EXISTS
+# template's store_returns lives inside the subquery residual — its
+# outer graph has no equi edge to hash on, so it stays unpartitioned.
 _STREAM_AB_PARTITIONED = tuple(
     i for i, (q, _must) in enumerate(_STREAM_AB_QUERIES)
-    if "store_returns" in q)
+    if "store_returns" in q and "exists" not in q)
 
 # the partition count every A/B partitioned sweep forces (the toy
 # session's bounds all fit 16 GiB, so auto mode would never partition)
@@ -300,21 +355,27 @@ _STREAM_AB_PARTITION_COUNT = 2
 
 @contextlib.contextmanager
 def _forced_stream_partitions(n=_STREAM_AB_PARTITION_COUNT):
-    """Pin NDS_TPU_STREAM_PARTITIONS for one A/B sweep — the ONE
-    save/set/restore shared by test_streamed_compiled_matches_eager and
-    both differential harnesses (tools/exec_audit_diff.py,
-    tools/mem_audit_diff.py), so the forced count can never drift
-    between the fixtures and their checkers."""
+    """Pin NDS_TPU_STREAM_PARTITIONS — and STRICT stream failures — for
+    one A/B sweep: the ONE save/set/restore shared by
+    test_streamed_compiled_matches_eager and both differential harnesses
+    (tools/exec_audit_diff.py, tools/mem_audit_diff.py), so the forced
+    count can never drift between the fixtures and their checkers.
+    NDS_TPU_STREAM_STRICT=1 re-raises any record/trace failure that is
+    not a StreamSyncError/ReplayMismatch: a genuine engine bug must fail
+    the sweep, never hide inside an eager fallback."""
     import os
-    old = os.environ.get("NDS_TPU_STREAM_PARTITIONS")
+    old = {k: os.environ.get(k) for k in ("NDS_TPU_STREAM_PARTITIONS",
+                                          "NDS_TPU_STREAM_STRICT")}
     os.environ["NDS_TPU_STREAM_PARTITIONS"] = str(n)
+    os.environ["NDS_TPU_STREAM_STRICT"] = "1"
     try:
         yield n
     finally:
-        if old is None:
-            del os.environ["NDS_TPU_STREAM_PARTITIONS"]
-        else:
-            os.environ["NDS_TPU_STREAM_PARTITIONS"] = old
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def test_streamed_chunked_sync_budget(rng):
@@ -368,7 +429,10 @@ def test_streamed_compiled_matches_eager():
             events = drain_stream_events()
             paths = [e.path for e in events]
             if must_stream:
-                assert paths == ["compiled"], \
+                # a multi-pass statement may chain SEVERAL compiled
+                # pipelines (the inner subquery's + the outer scan's);
+                # every one of them must have compiled
+                assert paths and all(p == "compiled" for p in paths), \
                     f"compiled arm fell back ({paths}) on: {q}"
                 assert used <= 6, \
                     f"streamed template used {used} syncs (budget 6): {q}"

@@ -133,12 +133,19 @@ def predict(queries):
 
 
 # Which runtime fallback-reason texts each static reason code explains.
-# The runtime reports the *mechanism* (which exception broke the trace);
-# the model reports the *plan feature* that guarantees that mechanism —
-# this table is the bridge, checked below so a new routing cause in the
+# The runtime reports the *mechanism* (which exception broke the trace,
+# now tagged with the exception CLASS — "trace diverged [X]: ..."); the
+# model reports the *plan feature* that guarantees that mechanism — this
+# table is the bridge, checked below so a new routing cause in the
 # executor (a reason text no static code explains) fails the harness.
+# The whole sweep additionally runs under NDS_TPU_STREAM_STRICT=1 (via
+# the shared _forced_stream_partitions context): a fallback caused by
+# anything other than StreamSyncError/ReplayMismatch re-raises outright,
+# so a genuine engine bug can never masquerade as a routing reason here.
+# subquery-residual survives as a code for foreign corpora; the shipped
+# corpus pre-plans every subquery residual (multi-pass streaming).
 _REASON_EVIDENCE = {
-    "subquery-residual": ("trace diverged: unknown table",),
+    "subquery-residual": ("trace diverged",),
     "chunk-dependent-host-read": ("not chunk-invariant", "trace diverged"),
     "non-invariant-graph": ("not chunk-invariant", "trace diverged"),
     "outer-join-extras": ("bound-bucket overflow",),

@@ -35,6 +35,12 @@ the checked contract, mirroring ``tools/exec_audit_diff.py``:
     (a provable bound that the executor rejects means the model and
     ``stream_graph_fanout`` drifted apart).
 
+The whole sweep runs under ``NDS_TPU_STREAM_STRICT=1`` (set by the
+shared ``_forced_stream_partitions`` context from tests/test_synccount):
+a record/trace failure that is not a legitimate routing exception
+re-raises and fails the harness outright, so an engine bug can never
+pose as an eager fallback while the bounds quietly stop being checked.
+
 ``--inject-drift`` zeroes every predicted bound — the per-partition
 bounds INCLUDED — before comparing: a model-drift fixture that MUST
 fail in both the whole-scan and the partition direction, proving the

@@ -74,7 +74,13 @@ def report(trace_dir, top=10):
     sites = Counter()
     site_tag = {}
     fallbacks = defaultdict(lambda: {"queries": 0, "ms": 0.0, "syncs": 0,
-                                     "rerun_ms": 0.0})
+                                     "rerun_ms": 0.0, "chunks": 0})
+    # compiled-path unit costs measured from THIS run's streamed
+    # statements: the basis of the projected-savings column (what an
+    # eager fallback would roughly cost compiled — per-chunk drive time
+    # of comparable pipelines plus one materialize)
+    drive_ms, drive_n = 0.0, 0
+    mat_ms, mat_n = 0.0, 0
     for path in files:
         query, events = load_trace(path)
 
@@ -98,11 +104,18 @@ def report(trace_dir, top=10):
             args = e.get("args") or {}
             row["phases"][name if name in PHASES else "other"] += \
                 e["self"] / 1e3
+            if name == "stream.drive":
+                drive_ms += e["self"] / 1e3
+                drive_n += 1
+            if name == "stream.materialize":
+                mat_ms += e["self"] / 1e3
+                mat_n += 1
             if name == "stream" and args.get("path") == "eager":
                 fb = fallbacks[args.get("reason", "?")]
                 fb["queries"] += 1
                 fb["ms"] += e["dur"] / 1e3
                 fb["syncs"] += args.get("syncs", 0)
+                fb["chunks"] += args.get("chunks", 0)
             if name == "stream.overflow-rerun":
                 # an overflow rerun's eager loop: the enclosing stream
                 # span's remainder is the WASTED compiled-pipeline work
@@ -148,16 +161,29 @@ def report(trace_dir, top=10):
     lines.append("")
     if fallbacks:
         lines.append("# eager-fallback cost by reason (the streamability "
-                     "widening worklist)")
+                     "widening worklist; projected = measured eager ms "
+                     "minus a compiled-path estimate from this run's "
+                     "per-chunk drive cost)")
         ranked = sorted(fallbacks.items(),
                         key=lambda kv: kv[1]["ms"], reverse=True)
+        per_drive = drive_ms / drive_n if drive_n else None
+        per_mat = mat_ms / mat_n if mat_n else 0.0
         for reason, fb in ranked:
             extra = ""
             if fb["rerun_ms"]:
                 wasted = max(fb["ms"] - fb["rerun_ms"], 0.0)
                 extra = (f"  (overflow rerun: {fb['rerun_ms']:.1f} ms "
                          f"eager + {wasted:.1f} ms wasted pipeline)")
-            lines.append(f"  {fb['ms']:9.1f} ms  {fb['syncs']:4d} syncs  "
+            if per_drive is not None and fb["chunks"]:
+                est = fb["chunks"] * per_drive + fb["queries"] * per_mat
+                proj = f"{max(fb['ms'] - est, 0.0):9.1f} ms saved"
+            else:
+                # no compiled pipeline ran (no drive-cost basis) or the
+                # span carried no chunk count: the projection is unpriced
+                # (width-matched to the priced format above)
+                proj = f"{'n/a':>12} saved"
+            lines.append(f"  {fb['ms']:9.1f} ms  {proj}  "
+                         f"{fb['syncs']:4d} syncs  "
                          f"{fb['queries']:3d} scans  {reason}{extra}")
     else:
         lines.append("# no eager-fallback streamed scans in this run")
