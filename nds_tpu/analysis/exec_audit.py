@@ -85,6 +85,21 @@ with no device in the loop, answers for every template:
    themselves live in :mod:`nds_tpu.analysis.mem_audit` (the
    ``hbm-capacity`` gate + ``--mem-report``).
 
+**Encoded columnar execution is sync-free.** The streamed chunk path may
+upload int/date/decimal columns as narrow FOR/dictionary codes
+(``io/columnar.py`` + ``engine/column.py``): the encoding plan is built
+on HOST from whole-table stats before any chunk uploads (chunk-invariant,
+like the string dictionaries), predicates and join keys either evaluate
+directly on encoded values or decode through a fused elementwise widen
+INSIDE the jitted per-chunk program, and the wide materialization happens
+on host after the single materializing transfer (mirroring
+``dict_values[codes]``). No step of encode or decode ever reads the
+device, so the sync-effect model charges encoded execution NOTHING — no
+bound in this module changes when ``NDS_TPU_ENCODED`` is on (the
+default). The contract is checked the same way as every other zero: the
+A/B templates run encoded by default through both differential harnesses,
+whose static sync bounds would fail if encode/decode started paying.
+
 **Trace instrumentation is sync-free.** The obs span layer
 (:mod:`nds_tpu.obs`) wraps the instrumented phases in host-clock spans
 that read only the thread's existing sync/wait/compile counters, so the

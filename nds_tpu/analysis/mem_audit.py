@@ -20,7 +20,15 @@ every template:
      :mod:`nds_tpu.engine.column`: int32/date = 4 B, int64/double and
      scaled-decimal = 8 B, strings = 4 B dictionary codes (value tables
      live on host), plus 1 B validity per row — exactly the shapes
-     ``ChunkedTable.padded_chunks`` materializes.
+     ``ChunkedTable.padded_chunks`` materializes. Under ENCODED
+     execution (``NDS_TPU_ENCODED``, default on) streamed chunks are
+     priced at the statically-provable narrow widths instead
+     (:func:`encoded_type_width`: ``decimal(p<=9)`` -> 4+1 B,
+     spec-bounded quantities -> 2+1 B, ticket numbers -> 4+1 B at the
+     audited scale), mirroring the runtime codecs of
+     ``io/columnar.plan_column_codec`` — conservatively: a column the
+     model cannot prove narrow is priced plain even when the runtime
+     (which sees real stats) encodes it.
    * **cardinality bounds propagated through joins**: a join batch whose
      keys cover the non-streamed side's declared (composite) primary key
      on a pristine base-table scan is unique on that side — output rows
@@ -100,7 +108,8 @@ from nds_tpu.analysis.plan_audit import _single_row_query
 from nds_tpu.queries import (TEMPLATE_DIR, instantiate_template,
                              list_templates, load_template)
 from nds_tpu.schema import (COMPOSITE_PRIMARY_KEYS, PRIMARY_KEYS,
-                            get_schemas, is_decimal, is_string)
+                            decimal_precision_scale, get_schemas,
+                            is_decimal, is_string)
 from nds_tpu.sql import ast as A
 from nds_tpu.sql.parser import ParseError, parse
 
@@ -174,6 +183,70 @@ def type_width(t: str) -> int:
     if t in ("int32", "date"):
         return 4 + 1
     return 8 + 1                       # int64 / double / unknown
+
+
+# ---------------------------------------------------------------------------
+# encoded columnar execution: the static width model of the streamed path
+# ---------------------------------------------------------------------------
+#
+# The streamed scan path uploads int-path columns in a NARROW encoded
+# representation (io/columnar.plan_column_codec: frame-of-reference /
+# sorted-dict), and survivors stay encoded through the accumulator, so
+# the widths the proof prices shrink with the data. The RUNTIME chooses
+# widths from whole-table stats; this model mirrors that choice from
+# static knowledge only — schema types plus spec-fixed value domains at
+# the audited scale — and is deliberately conservative: a column the
+# model cannot prove narrow statically is priced at its plain width even
+# though the runtime may encode it narrower (sound for the capacity
+# gate; the runtime sizes its own accumulators from the ACTUAL encoded
+# dtypes, so the executor is never constrained by the model's caution).
+
+
+# the ONE NDS_TPU_ENCODED gate (read at model build time like every
+# other executor knob) — shared with the runtime so the model and the
+# executor can never read the flag differently
+from nds_tpu.io.columnar import encoded_enabled  # noqa: E402
+
+
+# spec-fixed value-domain upper bounds (TPC-DS: quantities are 1..100,
+# inventory levels 0..1000) — int64 columns a FOR encoding provably
+# narrows to int16 offsets at ANY scale factor
+SPEC_INT_DOMAINS = {
+    "ss_quantity": 100, "cs_quantity": 100, "ws_quantity": 100,
+    "sr_return_quantity": 100, "cr_return_quantity": 100,
+    "wr_return_quantity": 100, "inv_quantity_on_hand": 1000,
+}
+
+# int64 sequence columns whose value domain is bounded by their table's
+# row bound at the audited scale (ticket numbers are assigned per sale)
+ROW_BOUND_DOMAINS = {
+    "ss_ticket_number": "store_sales",
+    "sr_ticket_number": "store_returns",
+}
+
+
+def encoded_type_width(col: str, t: str, row_bounds: dict) -> int:
+    """Static streamed-chunk bytes per row of one column under encoded
+    execution (validity byte included). Mirrors the runtime codec rules
+    on what is provable WITHOUT data: a decimal's precision bounds its
+    scaled int64 (p <= 9 always fits an int32 FOR code), and the spec /
+    row-bound domains above prove int16/int32 for the quantity and
+    ticket-number columns. Everything else keeps its plain width."""
+    if is_decimal(t):
+        p, _s = decimal_precision_scale(t)
+        if p <= 9:
+            return 4 + 1
+        return 8 + 1
+    w = type_width(t)
+    dom = SPEC_INT_DOMAINS.get(col)
+    if dom is None and col in ROW_BOUND_DOMAINS:
+        dom = row_bounds.get(ROW_BOUND_DOMAINS[col])
+    if dom is not None:
+        if dom < (1 << 15):
+            return min(w, 2 + 1)
+        if dom < (1 << 31):
+            return min(w, 4 + 1)
+    return w
 
 
 # ---------------------------------------------------------------------------
@@ -630,16 +703,31 @@ class MemModel:
                 t: {f.name.lower(): type_width(f.type) for f in fields}
                 for t, fields in get_schemas(use_decimal=True).items()}
         self.widths = catalog              # table -> {col -> bytes/row}
+        # encoded execution (NDS_TPU_ENCODED, default on): streamed chunk
+        # scans are priced at the statically-provable encoded widths —
+        # the bounds (and therefore choose_partitions) shrink with the
+        # data. Same build-time env discipline as the other knobs.
+        self.encoded = encoded_enabled()
+        if self.encoded:
+            self.enc_widths = {
+                t: {c: encoded_type_width(c, f.type, self.row_bounds)
+                    for c, f in ((f.name.lower(), f) for f in fields)}
+                for t, fields in get_schemas(use_decimal=True).items()}
+        else:
+            self.enc_widths = {}
 
     def table_rows(self, name: str) -> int | None:
         return self.row_bounds.get(name)
 
-    def pruned_width(self, table: str, needed: set | None) -> int:
+    def pruned_width(self, table: str, needed: set | None,
+                     encoded: bool = False) -> int:
         """Bytes per row of ``table`` after the planner's column pruning
         (``needed`` = names the statement references; None disables
         pruning). An empty intersection keeps every column, exactly like
-        the planner (it never prunes to zero columns)."""
-        cols = self.widths.get(table, {})
+        the planner (it never prunes to zero columns). ``encoded`` prices
+        the streamed-chunk representation (narrow codecs)."""
+        cols = (self.enc_widths if encoded and self.encoded
+                else self.widths).get(table, {})
         if not cols:
             return 9                       # unknown table: one wide column
         if needed is not None:
@@ -687,7 +775,7 @@ class MemModel:
         if self.acc_ceiling is not None and rows > self.acc_ceiling:
             return False                   # hard ceiling: overflow certain
         bound = self.acc_row_bound(rows, 0)
-        return bound * self.pruned_width(table, needed) \
+        return bound * self.pruned_width(table, needed, encoded=True) \
             <= self.capacity_bytes
 
 
@@ -760,7 +848,7 @@ class _MRel:
     planner's merged alias-qualified columns)."""
 
     __slots__ = ("cols", "widths", "dom", "rows", "source", "chunked",
-                 "single_row")
+                 "single_row", "plain_widths")
 
     def __init__(self, alias, widths: dict, rows: int, dom: dict | None =
                  None, source=None, chunked=False, single_row=False):
@@ -772,6 +860,11 @@ class _MRel:
         self.source = source
         self.chunked = chunked
         self.single_row = single_row
+        # encoded execution: a chunked rel's ``widths`` price the narrow
+        # streamed representation; ``plain_widths`` keeps the unencoded
+        # widths for the paths that materialize the table whole (a
+        # non-kept chunked part binds device-resident, unencoded)
+        self.plain_widths = None
 
     @property
     def alias(self) -> str:
@@ -781,6 +874,22 @@ class _MRel:
     def width(self) -> int:
         return sum(w for cols in self.widths.values()
                    for w in cols.values())
+
+    @property
+    def plain_width(self) -> int:
+        """Unencoded width (equals ``width`` for unencoded rels) — the
+        byte size the runtime's whole-table materialization pays, and the
+        keep-choice tiebreak (the executor picks by arrow nbytes)."""
+        if self.plain_widths is None:
+            return self.width
+        return sum(self.plain_widths.values())
+
+    def use_plain_widths(self) -> None:
+        """Re-price this rel at its unencoded widths (non-kept chunked
+        parts materialize whole through the plain device path)."""
+        if self.plain_widths is not None:
+            self.widths = {self.alias: dict(self.plain_widths)}
+            self.plain_widths = None
 
     def colset(self) -> set:
         return {f"{a}.{c}" for a, cols in self.cols.items() for c in cols}
@@ -1041,8 +1150,18 @@ class MemAuditor:
             widths, rows, is_base = env.get(name, ({}, 1, False))
             widths = self._prune(widths)
             chunked = is_base and name in self.streamed
-            rel = _MRel(alias, widths, rows,
+            enc_widths = None
+            if chunked and self.model.encoded:
+                # streamed scans upload (and accumulate) the narrow
+                # encoded representation — the width the proof prices
+                enc_cols = self.model.enc_widths.get(name, {})
+                enc_widths = {c: enc_cols.get(c, w)
+                              for c, w in widths.items()}
+            rel = _MRel(alias, enc_widths if enc_widths is not None
+                        else widths, rows,
                         source=name if is_base else None, chunked=chunked)
+            if enc_widths is not None:
+                rel.plain_widths = dict(widths)
             if is_base and not chunked:
                 # a device-resident base scan uploads its pruned columns
                 cost.peak += _bucket(rows) * rel.width
@@ -1243,8 +1362,14 @@ class MemAuditor:
             base = 1 if p.single_row else max(p.rows, 1)
             comp_rows[r] = max(comp_rows.get(r, 1), base)
         chunked_idx = [i for i, p in enumerate(parts) if p.chunked]
+        # keep-choice mirrors the executor (largest by UNENCODED bytes:
+        # the runtime picks by arrow nbytes); non-kept chunked parts bind
+        # whole through the plain device path, so they re-price plain
         keep = max(chunked_idx, key=lambda i: parts[i].rows *
-                   max(parts[i].width, 1)) if chunked_idx else None
+                   max(parts[i].plain_width, 1)) if chunked_idx else None
+        for i in chunked_idx:
+            if i != keep:
+                parts[i].use_plain_widths()
         for (a, b), batch in batches.items():
             if not _batch_unique_side(part_cols, sources,
                                       keep if keep is not None else -1,

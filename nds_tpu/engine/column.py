@@ -14,6 +14,17 @@ Device kinds (see :func:`nds_tpu.types.device_kind`):
 Null handling: every column optionally carries a ``valid`` bool mask; ``None``
 means all-valid. Data under invalid slots is zeroed so reductions can run
 unmasked where the zero is the identity.
+
+Encoded columns: the streamed chunk path (``ChunkedTable.padded_chunks``)
+may upload int/date/decimal columns in a NARROW encoded representation —
+frame-of-reference offsets from a per-table base (``logical = base +
+stored``) or sorted-dictionary codes (``logical = values[stored]``) —
+carried by :class:`Encoding` on ``Column.enc``. Both encodings are
+order-preserving, so predicates and join keys can evaluate directly on
+encoded values (constants fold to encoded space at trace time); any
+consumer that needs the logical values calls :meth:`Column.plain`, a
+fused elementwise decode inside the jit program (zero host syncs).
+Decode to arrow happens at materialize, mirroring ``dict_values[codes]``.
 """
 
 from __future__ import annotations
@@ -28,6 +39,58 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 _DEC_KIND_RE = re.compile(r"dec\((\d+),(\d+)\)")
+
+
+@dataclass
+class Encoding:
+    """Narrow device representation of an int-path column.
+
+    ``mode`` "for": stored codes are offsets from ``base`` — logical value
+    = ``base + stored``. ``mode`` "dict": stored codes index the SORTED
+    host-side ``values`` table — logical value = ``values[stored]``. Both
+    are order-preserving (dict values are sorted ascending), which is
+    what lets comparisons run in encoded space. Like a string column's
+    ``dict_values``, the encoding is host metadata shared identically by
+    every chunk of a table (chunk-invariant: a cache-key member)."""
+
+    mode: str                        # "for" | "dict"
+    base: int = 0                    # FOR: logical = base + stored
+    values: np.ndarray | None = None  # dict: sorted logical values (host)
+
+
+def encs_equal(a: Encoding | None, b: Encoding | None) -> bool:
+    """Value equality of two encodings (identity fast path) — the test
+    cached compiled programs apply before serving differently-encoded
+    buffers (mirrors ``stream._dicts_equal`` for string dictionaries)."""
+    if a is None or b is None:
+        return a is b
+    if a is b:
+        return True
+    if a.mode != b.mode or a.base != b.base:
+        return False
+    if a.values is None or b.values is None:
+        return a.values is b.values
+    return a.values is b.values or np.array_equal(a.values, b.values)
+
+
+def enc_key(enc: Encoding | None):
+    """Hashable cache-key signature of an encoding (value tables are
+    validated separately by identity/content, like string dictionaries)."""
+    if enc is None:
+        return None
+    return (enc.mode, enc.base,
+            None if enc.values is None else len(enc.values))
+
+
+# logical (decoded) dtype per device kind — what plain() widens to
+_WIDE_DTYPES = {"i32": "int32", "date": "int32", "i64": "int64",
+                "bool": "bool", "f64": "float64"}
+
+
+def _wide_dtype(kind: str):
+    if kind.startswith("dec("):
+        return np.dtype("int64")
+    return np.dtype(_WIDE_DTYPES.get(kind, "int64"))
 
 
 def dec_scale(kind: str) -> int:
@@ -51,9 +114,29 @@ class Column:
     data: jnp.ndarray
     valid: jnp.ndarray | None = None          # bool mask; None = all valid
     dict_values: np.ndarray | None = None     # host-side strings for kind 'str'
+    enc: Encoding | None = None               # narrow encoded representation
 
     def __len__(self) -> int:
         return int(self.data.shape[0])
+
+    def plain(self) -> "Column":
+        """Decode an encoded column to its logical (wide) representation.
+        A fused elementwise device op — inside a jit program this costs
+        nothing extra and never syncs. Invalid slots are re-zeroed to
+        preserve the zero-under-null invariant (an encoded 0 decodes to
+        ``base``, not 0)."""
+        if self.enc is None:
+            return self
+        wide = _wide_dtype(self.kind)
+        if self.enc.mode == "for":
+            data = self.data.astype(wide) + jnp.asarray(self.enc.base,
+                                                        dtype=wide)
+        else:                                  # "dict": sorted value table
+            data = jnp.take(jnp.asarray(self.enc.values.astype(wide)),
+                            self.data, mode="clip")
+        if self.valid is not None:
+            data = jnp.where(self.valid, data, jnp.zeros((), dtype=wide))
+        return replace(self, data=data, enc=None)
 
     @property
     def scale(self) -> int:
@@ -250,11 +333,34 @@ def slice_col_prefix(col: Column, cap: int) -> Column:
     return _slice_col(col, cap)
 
 
+def _decode_host(col: Column) -> Column:
+    """Host-side decode of an encoded column whose data is already a
+    fetched numpy array (materialize path): the device->host transfer
+    moved the NARROW codes, and the widening happens here — the exact
+    analogue of ``dict_values[codes]`` for strings."""
+    if col.enc is None:
+        return col
+    wide = _wide_dtype(col.kind)
+    codes = np.asarray(col.data)
+    if col.enc.mode == "for":
+        data = codes.astype(wide) + wide.type(col.enc.base)
+    else:
+        data = col.enc.values.astype(wide)[
+            np.clip(codes, 0, len(col.enc.values) - 1)]
+    if col.valid is not None:
+        data = np.where(np.asarray(col.valid), data,
+                        np.zeros((), dtype=wide))
+    return replace(col, data=data, enc=None)
+
+
 def column_to_arrow(col: Column, nrows: int | None = None) -> pa.Array:
-    """Device -> arrow; ``nrows`` drops the padding before the transfer."""
+    """Device -> arrow; ``nrows`` drops the padding before the transfer.
+    Encoded columns decode on HOST after the fetch, so the transfer moves
+    the narrow representation."""
     col = _slice_col(col, nrows)
     if not isinstance(col.data, np.ndarray):     # not already fetched
         col = _fetch_columns([col])[0]
+    col = _decode_host(col)
     valid_np = None if col.valid is None else np.asarray(col.valid)
 
     if col.kind == "str":

@@ -16,8 +16,8 @@ import re
 import jax.numpy as jnp
 import numpy as np
 
-from nds_tpu.engine.column import Column, is_dec
-from nds_tpu.engine.ops import ordered_codes_merged
+from nds_tpu.engine.column import Column, encs_equal, is_dec
+from nds_tpu.engine.ops import ordered_codes_merged, plain_col
 
 _MAX_DEC_SCALE = 10
 _str_literal_dicts: dict = {}
@@ -61,7 +61,14 @@ def literal(value, n: int) -> Column:
 # ---------------------------------------------------------------------------
 
 
+# the scalar kernels funnel value consumption through the ONE decode
+# choke point (ops.plain_col); comparisons keep a fast path that stays
+# in encoded space (see compare)
+_plain = plain_col
+
+
 def _as_f64(col: Column) -> jnp.ndarray:
+    col = _plain(col)
     d = col.data.astype(jnp.float64)
     if is_dec(col.kind):
         d = d / (10.0 ** col.scale)
@@ -76,6 +83,7 @@ def _combine_valid(a: Column, b: Column):
 
 def _align_decimals(a: Column, b: Column):
     """Bring two int-path numeric columns to a common scale."""
+    a, b = _plain(a), _plain(b)
     sa, sb = a.scale, b.scale
     s = max(sa, sb)
     da = a.data.astype(jnp.int64) * (10 ** (s - sa))
@@ -88,6 +96,7 @@ def _int_path(col: Column) -> bool:
 
 
 def arith(op: str, a: Column, b: Column) -> Column:
+    a, b = _plain(a), _plain(b)        # arithmetic needs logical values
     valid = _combine_valid(a, b)
     if op == "/":
         num, den = _as_f64(a), _as_f64(b)
@@ -143,6 +152,7 @@ def arith(op: str, a: Column, b: Column) -> Column:
 
 
 def negate(a: Column) -> Column:
+    a = _plain(a)
     if a.kind == "f64":
         return Column("f64", -a.data, a.valid)
     return Column(a.kind if is_dec(a.kind) else "i64",
@@ -154,6 +164,33 @@ def negate(a: Column) -> Column:
 # ---------------------------------------------------------------------------
 
 
+def _encoded_compare_views(a: Column, b: Column):
+    """Encoded-space comparison views, or None when the pair must decode.
+
+    Both FOR and sorted-dict encodings are order-preserving, so two sides
+    sharing ONE encoding compare by raw codes. For a FOR side against a
+    plain int-path side at the same scale, the comparison rebases the
+    PLAIN side into the encoded space (``code op (other - base)``) — when
+    the other side is a broadcast literal the subtraction folds to a
+    constant at trace time, so the predicate runs entirely on the narrow
+    encoded column."""
+    if a.enc is not None and b.enc is not None:
+        # same encoding AND same scale: codes of a dec(7,2) and an int
+        # column can share (mode, base) while meaning values 100x apart,
+        # so scale must align exactly like _align_decimals would
+        if encs_equal(a.enc, b.enc) and a.scale == b.scale:
+            return a.data.astype(jnp.int64), b.data.astype(jnp.int64)
+        return None
+    enc_side, plain_side = (a, b) if a.enc is not None else (b, a)
+    if enc_side.enc.mode != "for" or plain_side.enc is not None or \
+            enc_side.scale != plain_side.scale or plain_side.kind == "f64":
+        return None
+    base = jnp.asarray(enc_side.enc.base, dtype=jnp.int64)
+    ev = enc_side.data.astype(jnp.int64)
+    pv = plain_side.data.astype(jnp.int64) - base
+    return (ev, pv) if enc_side is a else (pv, ev)
+
+
 def compare(op: str, a: Column, b: Column) -> Column:
     valid = _combine_valid(a, b)
     if a.kind == "str" or b.kind == "str":
@@ -163,7 +200,12 @@ def compare(op: str, a: Column, b: Column) -> Column:
             raise TypeError("cannot compare string with non-string")
         da, db = la, lb
     elif _int_path(a) and _int_path(b):
-        da, db, _ = _align_decimals(a, b)
+        views = _encoded_compare_views(a, b) \
+            if (a.enc is not None or b.enc is not None) else None
+        if views is not None:
+            da, db = views
+        else:
+            da, db, _ = _align_decimals(a, b)
     else:
         da, db = _as_f64(a), _as_f64(b)
     out = {
@@ -218,6 +260,7 @@ def logical_not(a: Column) -> Column:
 
 def _unify(cols):
     """Bring branch results to one kind (for CASE/COALESCE/IF)."""
+    cols = [_plain(c) for c in cols]
     kinds = {c.kind for c in cols}
     if len(kinds) == 1 and "str" not in kinds:
         return cols, cols[0].kind
@@ -296,6 +339,7 @@ def coalesce(cols) -> Column:
 def cast(col: Column, target: str) -> Column:
     """target: canonical-ish SQL type name (int, bigint, double, decimal(p,s),
     date, string, char(n), varchar(n))."""
+    col = _plain(col)
     t = target.lower().replace(" ", "")
     if t in ("int", "integer", "i32"):
         if col.kind == "str":
@@ -530,12 +574,14 @@ def fn_in_strings(col: Column, values) -> Column:
 
 
 def fn_abs(col: Column) -> Column:
+    col = _plain(col)
     if col.kind == "f64":
         return Column("f64", jnp.abs(col.data), col.valid)
     return Column(col.kind, jnp.abs(col.data), col.valid)
 
 
 def fn_round(col: Column, digits: int = 0) -> Column:
+    col = _plain(col)
     if is_dec(col.kind):
         s = col.scale
         if digits >= s:

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from nds_tpu.engine.column import Column, is_dec
+from nds_tpu.engine.column import Column, encs_equal, is_dec
 from nds_tpu.engine.table import DeviceTable
 from nds_tpu.obs import trace as _trace
 
@@ -782,8 +782,22 @@ def ordered_codes(col: Column) -> jnp.ndarray:
     return jnp.take(_dict_ranks(col.dict_values)[0], col.data)
 
 
+def plain_col(col: Column) -> Column:
+    """Decoded (logical-representation) view of a possibly-encoded column
+    — the one choke point value-consuming ops funnel through. A fused
+    elementwise device op, zero host syncs (see Column.plain)."""
+    return col.plain() if col.enc is not None else col
+
+
+def plain_data(col: Column) -> jnp.ndarray:
+    """Decoded data array of a possibly-encoded column."""
+    return col.plain().data if col.enc is not None else col.data
+
+
 def sortable_view(col: Column) -> jnp.ndarray:
-    """Numeric view of a column that sorts in SQL ascending order."""
+    """Numeric view of a column that sorts in SQL ascending order.
+    FOR/dict int encodings are order-preserving, so encoded codes sort
+    exactly like the logical values — no decode needed."""
     if col.kind == "str":
         return ordered_codes(col)
     if col.kind == "bool":
@@ -1098,6 +1112,7 @@ def _agg_sum_impl(data, valid, gids, ngroups, as_f64):
 
 
 def agg_sum(col: Column, gids, ngroups) -> Column:
+    col = plain_col(col)           # sums need logical values (fused decode)
     if col.kind == "f64":
         from nds_tpu.engine.kernels import pallas_active, segment_sum_fused
         if pallas_active(ngroups):
@@ -1171,7 +1186,10 @@ def agg_min(col: Column, gids, ngroups, is_max=False) -> Column:
         return Column("str", codes.astype(jnp.int32), out_valid, col.dict_values)
     if col.kind == "f64":
         return Column("f64", out, out_valid)
-    return Column(col.kind, out.astype(col.data.dtype), out_valid)
+    # order-preserving encodings: min/max of codes IS the code of the
+    # min/max value, so the result stays encoded (decode at materialize)
+    return Column(col.kind, out.astype(col.data.dtype), out_valid,
+                  enc=col.enc)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -1184,6 +1202,7 @@ def _agg_avg_impl(data, valid, gids, ngroups):
 
 
 def agg_avg(col: Column, gids, ngroups) -> Column:
+    col = plain_col(col)
     if is_dec(col.kind):
         # exact MXU sum first (same gate as agg_sum), then one f64 divide:
         # better than accumulating rounded f64 terms AND rides the hardware
@@ -1232,6 +1251,7 @@ def _agg_stddev_impl(data, valid, gids, ngroups):
 
 
 def agg_stddev_samp(col: Column, gids, ngroups) -> Column:
+    col = plain_col(col)
     data = col.data.astype(jnp.float64)
     if is_dec(col.kind):
         data = data / (10.0 ** col.scale)
@@ -1329,7 +1349,10 @@ def _hash_views(left_keys, right_keys):
         if lk.kind == "str" and rk.kind == "str":
             lv, rv = ordered_codes_merged(lk, rk)
         else:
-            lv, rv = lk.data, rk.data
+            # encoded int keys decode to the shared logical space (codes
+            # from different encodings are not comparable) — a fused
+            # elementwise widen inside the jit program, zero syncs
+            lv, rv = plain_data(lk), plain_data(rk)
         lviews.append(lv)
         rviews.append(rv)
     return tuple(lviews), tuple(rviews)
@@ -1348,8 +1371,8 @@ def _verify_pairs(l_idx, r_idx, left_keys, right_keys,
             lv = jnp.take(lmap, l_idx)
             rv = jnp.take(rmap, r_idx)
         else:
-            lv = jnp.take(lk.data, l_idx)
-            rv = jnp.take(rk.data, r_idx)
+            lv = jnp.take(plain_data(lk), l_idx)
+            rv = jnp.take(plain_data(rk), r_idx)
         eq = lv == rv
         lvalid = None if lk.valid is None else jnp.take(lk.valid, l_idx)
         rvalid = None if rk.valid is None else jnp.take(rk.valid, r_idx)
@@ -1550,7 +1573,7 @@ def semi_join_mask(left_keys, right_keys, negate: bool = False,
         if lk.kind == "str" and rk.kind == "str":
             lview, rview = ordered_codes_merged(lk, rk)
         elif lk.kind != "str" and rk.kind != "str":
-            lview, rview = lk.data, rk.data
+            lview, rview = plain_data(lk), plain_data(rk)
         else:
             lview = rview = None
         if lview is not None:
@@ -1618,8 +1641,9 @@ def _dense_dim_info(dim_key: Column, n_dim: int):
     Cached per key-array identity — built once per loaded dimension, it
     replaces the per-join searchsorted (a 17-iteration binary-search loop
     over emulated int64, ~0.6s for a 4M-row probe on v5e) with ONE gather."""
-    if dim_key.kind == "str" or n_dim == 0 or n_dim > (1 << 24):
-        return None
+    if dim_key.kind == "str" or dim_key.enc is not None or n_dim == 0 \
+            or n_dim > (1 << 24):
+        return None                # encoded dim keys take the sort probe
 
     def compute():
         def fetch():
@@ -1694,7 +1718,7 @@ def pk_gather_join(fact_key: Column, dim_key: Column,
     if fact_key.kind == "str" and dim_key.kind == "str":
         fview, dview = ordered_codes_merged(fact_key, dim_key)
     else:
-        fview, dview = fact_key.data, dim_key.data
+        fview, dview = plain_data(fact_key), plain_data(dim_key)
         dense = _dense_dim_info(dim_key, n_dim)
         if dense is not None:
             base, pos_map = dense
@@ -1742,6 +1766,11 @@ def pk_gather_join_multi(fact_keys, dim_keys, n_fact: int, n_dim: int,
         return None
     if isinstance(n_dim, DeviceCount):      # host span plan (see above)
         n_dim = n_dim.to_int()
+    # encoded keys pack through their decoded logical views (the span
+    # plan is identity-cached per dim-key ARRAY, which is unencoded on
+    # every dimension; the fact side decodes fused)
+    fact_keys = [plain_col(c) for c in fact_keys]
+    dim_keys = [plain_col(c) for c in dim_keys]
 
     def compute():
         def fetch():
@@ -1784,7 +1813,8 @@ def pk_gather_join_multi(fact_keys, dim_keys, n_fact: int, n_dim: int,
 
 def _null_column_like(col: Column, n: int) -> Column:
     data = jnp.zeros((n,) + col.data.shape[1:], dtype=col.data.dtype)
-    return Column(col.kind, data, jnp.zeros(n, dtype=bool), col.dict_values)
+    return Column(col.kind, data, jnp.zeros(n, dtype=bool), col.dict_values,
+                  enc=col.enc)
 
 
 # candidate-pair budget for one materialized join chunk: beyond this the
@@ -2006,6 +2036,17 @@ def _align_str_dicts(cols):
         union
 
 
+def _align_encodings(cols):
+    """Decode parts whose encodings differ (codes from different
+    encodings are not concatenable); identical encodings concatenate
+    narrow and stay encoded — the partitioned accumulator union path."""
+    enc0 = cols[0].enc
+    if all(encs_equal(c.enc, enc0) for c in cols) and \
+            len({c.data.dtype for c in cols}) == 1:
+        return cols, enc0
+    return [plain_col(c) for c in cols], None
+
+
 def concat_columns(cols) -> Column:
     kind = cols[0].kind
     if kind == "str":
@@ -2013,8 +2054,9 @@ def concat_columns(cols) -> Column:
         data = jnp.concatenate(datas)
         valid = _concat_valids(cols)
         return Column("str", data.astype(jnp.int32), valid, dict_values)
+    cols, enc = _align_encodings(cols)
     data = jnp.concatenate([c.data for c in cols])
-    return Column(kind, data, _concat_valids(cols))
+    return Column(kind, data, _concat_valids(cols), enc=enc)
 
 
 def _concat_valids(cols):
@@ -2061,24 +2103,26 @@ def concat_tables(tables) -> DeviceTable:
     for n in names:
         cols = [t[n] for t in tables]
         kind = cols[0].kind
+        enc = None
         if kind == "str":
             datas, dict_values = _align_str_dicts(cols)
         else:
+            cols, enc = _align_encodings(cols)
             datas, dict_values = [c.data for c in cols], None
         vs = None if all(c.valid is None for c in cols) else \
             tuple(c.valid for c in cols)
         parts_datas.append(tuple(datas))
         parts_valids.append(vs)
-        metas.append((n, kind, dict_values))
+        metas.append((n, kind, dict_values, enc))
 
     part_nrows = tuple(count_int(t.nrows) for t in tables)
     datas, valids, live = _concat_cols_impl(
         tuple(parts_datas), tuple(parts_valids), part_nrows)
     out = {}
-    for (n, kind, dict_values), d, v in zip(metas, datas, valids):
+    for (n, kind, dict_values, enc), d, v in zip(metas, datas, valids):
         if kind == "str":
             d = d.astype(jnp.int32)
-        out[n] = Column(kind, d, v, dict_values)
+        out[n] = Column(kind, d, v, dict_values, enc)
     raw = DeviceTable(out, total)
     # fast path only when the summed physical length is itself a canonical
     # bucket: a non-bucket plen (e.g. 16+32=48) would leak into the XLA

@@ -285,7 +285,8 @@ def _flatten_part(part: DeviceTable):
     nrows = E.count_int(part.nrows)   # resolved up front by the caller
     for name in part.column_names:
         c = part[name]
-        spec.append((name, c.kind, c.dict_values, c.valid is not None))
+        spec.append((name, c.kind, c.dict_values, c.valid is not None,
+                     c.enc))
         flat.append(c.data)
         if c.valid is not None:
             flat.append(c.valid)
@@ -295,26 +296,40 @@ def _flatten_part(part: DeviceTable):
 def _rebuild_part(spec, flat):
     (cols_spec, nrows, plen) = spec
     cols, i = {}, 0
-    for name, kind, dv, has_valid in cols_spec:
+    for name, kind, dv, has_valid, enc in cols_spec:
         data = flat[i]
         i += 1
         valid = None
         if has_valid:
             valid = flat[i]
             i += 1
-        cols[name] = Column(kind, data, valid, dv)
+        cols[name] = Column(kind, data, valid, dv, enc)
     return DeviceTable(cols, nrows, plen=plen)
 
 
 def _chunk_signature(chunk: DeviceTable, alias: str):
     """Static chunk metadata: aliased names (the per-chunk program sees the
-    chunk as the planner's FROM-alias binding), kinds, dictionaries."""
+    chunk as the planner's FROM-alias binding), kinds, dictionaries, and
+    narrow encodings (host metadata baked into the trace, so a pipeline
+    compiled for one encoding must never serve another)."""
     spec = []
     for name in chunk.column_names:
         c = chunk[name]
         aliased = f"{alias.lower()}.{name.split('.')[-1].lower()}"
-        spec.append((aliased, c.kind, c.dict_values))
+        spec.append((aliased, c.kind, c.dict_values, c.enc))
     return tuple(spec)
+
+
+_LOGICAL_WIDTHS = {"i32": 4, "date": 4, "bool": 1, "f64": 8, "str": 4}
+
+
+def _logical_chunk_bytes(chunk_spec, chunk_cap, n_chunks) -> int:
+    """Unencoded upload bytes the same padded chunks WOULD have moved
+    (wide device widths + the validity byte) — the denominator of the
+    compression win tools/trace_report.py prices against bytesH2d."""
+    per_row = sum(_LOGICAL_WIDTHS.get(k, 8) + 1
+                  for (_n, k, _dv, _en) in chunk_spec)
+    return per_row * chunk_cap * max(n_chunks, 0)
 
 
 def _hash_mix(h, data):
@@ -390,7 +405,7 @@ class StreamPipeline:
         chunk_spec, chunk_cap = self.chunk_spec, self.chunk_cap
         part_specs, keep = self.part_specs, self.keep
         rec_log, operands = self.log, self.operands
-        names, kinds, dicts, valided, dtypes = self.out_template
+        names, kinds, dicts, valided, dtypes, encs = self.out_template
         acc_cap = self.acc_cap
         base_sources = list(sources)
         n_partitions, key_slots = self.n_partitions, self.key_slots
@@ -404,9 +419,9 @@ class StreamPipeline:
                    resid_flat, pids=None, part_id=None):
             acc_datas, acc_valids, acc_n, acc_ovf, acc_outer = acc
             cols, i = {}, 0
-            for (aname, kind, dv) in chunk_spec:
+            for (aname, kind, dv, cenc) in chunk_spec:
                 cols[aname] = Column(kind, chunk_flat[i], chunk_flat[i + 1],
-                                     dv)
+                                     dv, cenc)
                 i += 2
             chunk = DeviceTable(cols, E.DeviceCount(n_dev, chunk_cap),
                                 plen=chunk_cap)
@@ -520,7 +535,7 @@ class StreamPipeline:
         return tuple(flat)
 
     def init_acc(self):
-        names, kinds, dicts, valided, dtypes = self.out_template
+        names, kinds, dicts, valided, dtypes, encs = self.out_template
         datas, valids = [], []
         for j, dtype in enumerate(dtypes):
             datas.append(jnp.zeros(self.acc_cap, dtype=dtype))
@@ -556,8 +571,13 @@ class StreamPipeline:
         acc = self.init_acc()
         cur = first_chunk
         n_chunks = 0
+        h2d = 0
         while cur is not None:
             n_dev = jnp.asarray(E.count_int(cur.nrows), dtype=jnp.int64)
+            flat = self._flatten_chunk(cur)
+            # actual host->device prefetch bytes (buffer metadata, no
+            # sync): encoded columns upload their NARROW representation
+            h2d += sum(int(x.nbytes) for x in flat if x is not None)
             # asynchronous dispatch: the compiled call returns immediately,
             # so the NEXT chunk's arrow->device conversion (host slice +
             # upload) below overlaps this chunk's device compute — the
@@ -567,7 +587,7 @@ class StreamPipeline:
             # per chunk in the query trace.
             phase = "stream.drive" if self.traced_once else "stream.compile"
             with _obs.span(phase, chunk=n_chunks):
-                acc = self.jitted(self._flatten_chunk(cur), n_dev,
+                acc = self.jitted(flat, n_dev,
                                   parts_flat, self.operands, acc,
                                   resid_flat)
             self.traced_once = True
@@ -589,7 +609,8 @@ class StreamPipeline:
         with _obs.span("stream.materialize", chunks=n_chunks):
             total, overflowed, extras_n = E.timed_read("stream_final",
                                                        fetch)
-        evidence = {"outer": [(slot, m, n) for (slot, (m, _nd), n)
+        evidence = {"h2d": h2d,
+                    "outer": [(slot, m, n) for (slot, (m, _nd), n)
                               in zip(self.build_slots, miss, extras_n)]}
         if overflowed:
             return None, n_chunks, evidence
@@ -597,12 +618,13 @@ class StreamPipeline:
 
     def _slice_acc(self, datas, valids, total):
         """Survivor prefix of one accumulator as a DeviceTable."""
-        names, kinds, dicts, valided, dtypes = self.out_template
+        names, kinds, dicts, valided, dtypes, encs = self.out_template
         cap = E.bucket_len(total)
         cols = {}
         for j, n in enumerate(names):
             col = Column(kinds[j], datas[j],
-                         valids[j] if valided[j] else None, dicts[j])
+                         valids[j] if valided[j] else None, dicts[j],
+                         encs[j])
             cols[n] = slice_col_prefix(col, cap) if cap < self.acc_cap \
                 else col
         return DeviceTable(cols, total, plen=min(cap, self.acc_cap))
@@ -626,9 +648,11 @@ class StreamPipeline:
         pid_consts = [jnp.asarray(p, dtype=jnp.int32) for p in range(P)]
         cur = first_chunk
         n_chunks = 0
+        h2d = 0
         while cur is not None:
             n_dev = jnp.asarray(E.count_int(cur.nrows), dtype=jnp.int64)
             flat = self._flatten_chunk(cur)
+            h2d += sum(int(x.nbytes) for x in flat if x is not None)
             with _obs.span("stream.partition", chunk=n_chunks,
                            partitions=P):
                 pids, hist = self._pid_jit(flat, n_dev, hist)
@@ -666,7 +690,7 @@ class StreamPipeline:
             totals, overflowed, hist_host, extras_n = E.timed_read(
                 "stream_final", fetch)
         evidence = {"partitions": P, "part_rows": tuple(totals),
-                    "part_input": tuple(hist_host),
+                    "part_input": tuple(hist_host), "h2d": h2d,
                     "outer": [(slot, m, n) for (slot, (m, _nd), n)
                               in zip(self.build_slots, miss, extras_n)]}
         if any(overflowed):
@@ -703,13 +727,15 @@ def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
                part_infos, chunk_spec, chunk_cap, stream_rows, outer_meta):
     from nds_tpu.analysis.mem_audit import (stream_partitions_env,
                                             stream_skew_factor)
+    from nds_tpu.engine.column import enc_key
     from nds_tpu.sql.parser import expr_key
     return (
         tuple(expr_key(c) for c in join_preds),
         tuple(expr_key(c) for c in where_conjuncts),
         keep, tuple(sources), alias.lower(), chunk_cap,
-        tuple((n, k) for (n, k, _dv) in chunk_spec),
-        tuple(((tuple((cn, ck, hv) for (cn, ck, _dv, hv) in spec[0]),
+        tuple((n, k, enc_key(en)) for (n, k, _dv, en) in chunk_spec),
+        tuple(((tuple((cn, ck, hv, enc_key(en))
+                      for (cn, ck, _dv, hv, en) in spec[0]),
                 spec[1], spec[2]))
               for (spec, _flat) in part_infos),
         # deferred outer joins are part of the compiled program's shape
@@ -731,11 +757,13 @@ def _spec_match(a, b) -> bool:
     CONTENT) — the test a freshly replanned subquery residual must pass
     before a cached pipeline (whose program baked the old residual's
     shapes and recorded reads) may serve it."""
+    from nds_tpu.engine.column import encs_equal
     (ac, an, ap), (bc, bn, bp) = a, b
     if an != bn or ap != bp or len(ac) != len(bc):
         return False
-    for (n1, k1, d1, v1), (n2, k2, d2, v2) in zip(ac, bc):
-        if n1 != n2 or k1 != k2 or v1 != v2 or not _dicts_equal(d1, d2):
+    for (n1, k1, d1, v1, e1), (n2, k2, d2, v2, e2) in zip(ac, bc):
+        if n1 != n2 or k1 != k2 or v1 != v2 or not _dicts_equal(d1, d2) \
+                or not encs_equal(e1, e2):
             return False
     return True
 
@@ -768,12 +796,14 @@ def _cache_hit(key, chunk_spec, part_infos):
     # content-validate chunk dictionaries (a re-registered streamed table
     # re-encodes; same shapes, different value tables). A stale entry can
     # never hit again — evict it now rather than waiting for FIFO churn.
+    from nds_tpu.engine.column import encs_equal
     flat_now = [x for (_spec, flat) in part_infos for x in flat]
     then = [r() for r in pipe.part_refs]
     stale = len(flat_now) != len(then) or \
         any(b is None or a is not b for a, b in zip(flat_now, then)) or \
         any(not _dicts_equal(dv_now, dv_then)
-            for (_, _, dv_now), (_, _, dv_then)
+            or not encs_equal(en_now, en_then)
+            for (_, _, dv_now, en_now), (_, _, dv_then, en_then)
             in zip(chunk_spec, pipe.chunk_spec))
     if stale:
         with _PIPELINE_LOCK:
@@ -927,12 +957,17 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
                                          n_extras, out))
     if extras:
         out = E.concat_tables([out] + extras)
+    h2d = evidence.get("h2d", -1)
     record_stream_event(alias, ran, E.sync_count() - syncs0, "compiled",
                         rows=survivor_total,
                         partitions=evidence.get("partitions", 1),
-                        part_rows=evidence.get("part_rows", ()))
+                        part_rows=evidence.get("part_rows", ()),
+                        bytes_h2d=h2d)
     _obs.annotate(path="compiled", chunks=ran,
-                  partitions=evidence.get("partitions", 1))
+                  partitions=evidence.get("partitions", 1),
+                  bytesH2d=h2d,
+                  bytesLogical=_logical_chunk_bytes(pipe.chunk_spec,
+                                                    pipe.chunk_cap, ran))
     return out, None
 
 
@@ -1016,7 +1051,11 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
                 [out0[n].kind for n in names],
                 [out0[n].dict_values for n in names],
                 [out0[n].valid is not None for n in names],
-                [out0[n].data.dtype for n in names])
+                [out0[n].data.dtype for n in names],
+                # survivors carry their narrow encodings into the
+                # accumulator (decode only at materialize) — the proof-
+                # sized allocation shrinks with the data
+                [out0[n].enc for n in names])
     # size the survivor accumulator from the statement's proven row bound
     # (static memory model) instead of the old global guess: a statement
     # whose bound fits the capacity model can never overflow-rerun
@@ -1034,7 +1073,7 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
     if n_parts > 1:
         # map the partition keys (bare names) to the chunk's flattened
         # buffer slots (2 slots per column: data, valid)
-        spec_names = [nm for (nm, _k, _dv) in chunk_spec]
+        spec_names = [nm for (nm, _k, _dv, _en) in chunk_spec]
         for key in part_keys:
             hit = [i for i, nm in enumerate(spec_names)
                    if nm.split(".")[-1] == key]

@@ -107,6 +107,11 @@ class ChunkedTable:
         # executor (built lazily by padded_chunks; shared across select()
         # views, since a projection never changes column contents)
         self._str_store: dict = {}
+        # per-column narrow codecs (io/columnar.plan_column_codec): whole-
+        # table FOR/dict encodings the padded chunks slice — same shared-
+        # store discipline as the string dictionaries. None marks a column
+        # already found unencodable, so the stats pass runs once.
+        self._enc_store: dict = {}
 
     @property
     def nrows(self) -> int:
@@ -124,6 +129,7 @@ class ChunkedTable:
         out = ChunkedTable(self.arrow.select(names), self.canonical_types,
                            self.chunk_rows)
         out._str_store = self._str_store
+        out._enc_store = self._enc_store
         return out
 
     def device_chunks(self):
@@ -190,6 +196,31 @@ class ChunkedTable:
             enc[name] = self._str_store[name] = (codes, values, valid)
         return enc
 
+    def _int_encodings(self) -> dict:
+        """name -> (narrow whole-table codes, valid | None, Encoding) for
+        every encodable int-path column (io/columnar.plan_column_codec),
+        computed ONCE per table and shared across select() views — the
+        same chunk-invariance discipline as the string dictionaries, so
+        the compiled streaming executor's single traced program serves
+        every chunk and the Encoding objects are cache-key members.
+        Empty when NDS_TPU_ENCODED=0 (the escape hatch; read per call,
+        the computed plan stays cached for a later re-enable)."""
+        from nds_tpu.io.columnar import encoded_enabled, plan_column_codec
+        if not encoded_enabled():
+            return {}
+        from nds_tpu import types as _t
+        out = {}
+        for name in self.arrow.column_names:
+            if name not in self._enc_store:
+                ct = self.canonical_types.get(name) or _t.arrow_to_canonical(
+                    self.arrow.schema.field(name).type)
+                self._enc_store[name] = plan_column_codec(self.arrow[name],
+                                                          ct)
+            got = self._enc_store[name]
+            if got is not None:
+                out[name] = got
+        return out
+
     def padded_chunks(self):
         """Yield DeviceTable chunks at ONE uniform physical capacity
         (``chunk_cap``), the final partial chunk zero-padded up to it, with
@@ -205,6 +236,7 @@ class ChunkedTable:
         cap = self.chunk_cap
         n = self.arrow.num_rows
         strings = self._string_encodings()
+        narrow = self._int_encodings()
         for s in (range(0, n, self.chunk_rows) if n else (0,)):
             live = min(self.chunk_rows, n - s) if n else 0
             live_np = np.arange(cap) < live
@@ -224,6 +256,20 @@ class ChunkedTable:
                     continue
                 ct = self.canonical_types.get(name) or _t.arrow_to_canonical(
                     self.arrow.schema.field(name).type)
+                if name in narrow:
+                    # encoded upload: slice the whole-table narrow codes
+                    # (host->device moves 2/4 B per row instead of 4/8)
+                    codes, valid, enc = narrow[name]
+                    data = np.zeros(cap, dtype=codes.dtype)
+                    data[:live] = codes[s:s + live]
+                    v = live_np if valid is None else \
+                        live_np & np.concatenate(
+                            [valid[s:s + live],
+                             np.zeros(cap - live, dtype=bool)])
+                    cols[name] = Column(_t.device_kind(ct),
+                                        jnp.asarray(data),
+                                        jnp.asarray(v), None, enc)
+                    continue
                 c = from_arrow_array(sl[name], ct, cap)
                 # canonical validity structure: a chunk without nulls must
                 # present the same pytree as a sibling with them, or every

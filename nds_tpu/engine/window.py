@@ -107,6 +107,7 @@ class WindowContext:
 
     def partition_agg(self, col: Column, agg: str) -> Column:
         """sum/avg/min/max/count over the whole partition, broadcast per row."""
+        col = col.plain()                 # window math needs logical values
         valid = jnp.take(col.valid_mask(), self.order)
         data = jnp.take(col.data, self.order)
         if agg == "count":
@@ -187,6 +188,7 @@ class WindowContext:
         """sum/count/avg/min/max over (partition ... order ... unbounded
         preceding .. current row). ``rows_frame`` selects ROWS semantics;
         the SQL default frame is RANGE (order-key peers included)."""
+        col = col.plain()
         valid = jnp.take(col.valid_mask(), self.order)
         data = jnp.take(col.data, self.order)
         is_f = col.kind == "f64"

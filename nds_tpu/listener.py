@@ -121,6 +121,11 @@ class StreamEvent:
     part_rows: tuple = ()      # per-partition survivor counts (partition
     #                            order) — checked against the static
     #                            per-partition bounds by mem_audit_diff
+    bytes_h2d: int = -1        # actual host->device prefetch bytes the
+    #                            scan uploaded (encoded columnar: the
+    #                            NARROW representation — compression wins
+    #                            are measured here, not asserted; -1 =
+    #                            unknown)
 
 
 _stream_tls = threading.local()
@@ -128,7 +133,8 @@ _stream_tls = threading.local()
 
 def record_stream_event(where: str, chunks: int, syncs: int, path: str,
                         reason: str = "", rows: int = -1,
-                        partitions: int = 1, part_rows=()) -> None:
+                        partitions: int = 1, part_rows=(),
+                        bytes_h2d: int = -1) -> None:
     """Engine-side hook (engine/stream.py, sql/planner.py): record how a
     streamed scan executed. Thread-scoped like the sync counters, so
     concurrent Throughput streams account their own pipelines."""
@@ -137,7 +143,7 @@ def record_stream_event(where: str, chunks: int, syncs: int, path: str,
         # deque(maxlen): diagnostics ring, never unbounded, O(1) evict
         lst = _stream_tls.events = deque(maxlen=1000)
     lst.append(StreamEvent(where, chunks, syncs, path, reason, rows,
-                           partitions, tuple(part_rows)))
+                           partitions, tuple(part_rows), bytes_h2d))
 
 
 def drain_stream_events() -> list:
@@ -160,6 +166,7 @@ def stream_event_json(e: StreamEvent) -> dict:
         "table": e.where, "chunks": e.chunks, "syncs": e.syncs,
         "path": e.path,
         **({"rows": e.rows} if e.rows >= 0 else {}),
+        **({"bytesH2d": e.bytes_h2d} if e.bytes_h2d >= 0 else {}),
         **({"partitions": e.partitions, "partRows": list(e.part_rows)}
            if e.partitions > 1 else {}),
         **({"reason": e.reason} if e.reason else {}),

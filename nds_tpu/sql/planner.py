@@ -144,7 +144,7 @@ def outer_extras_table(build: DeviceTable, idx, n_extras,
         else:
             data = jnp.zeros((cap,) + t.data.shape[1:], dtype=t.data.dtype)
             cols[n] = Column(t.kind, data, jnp.zeros(cap, dtype=bool),
-                             t.dict_values)
+                             t.dict_values, t.enc)
     return DeviceTable(cols, n_extras, plen=cap)
 
 
@@ -819,7 +819,7 @@ class Planner:
                         for n, c in rg.columns.items():
                             cols[n] = Column(c.kind, c.data,
                                              c.valid_mask() & matched,
-                                             c.dict_values)
+                                             c.dict_values, c.enc)
                         return DeviceTable(cols, left.nrows, plen=left.plen)
             return E.join_tables(left, right, l_on, r_on, kind)
         # join with residual and/or expression keys: match pairs on the key
@@ -1054,10 +1054,11 @@ class Planner:
             return self._conjunct_mask_eager(table, conjuncts)
         plen = table.plen
 
-        def build_impl(ev, names, kinds, dict_refs, meta):
+        def build_impl(ev, names, kinds, dict_refs, encs, meta):
             def impl(datas, valids):
-                tcols = {n: Column(k, d, v, dv) for n, k, d, v, dv in
-                         zip(names, kinds, datas, valids, dict_refs)}
+                tcols = {n: Column(k, d, v, dv, en) for n, k, d, v, dv, en
+                         in zip(names, kinds, datas, valids, dict_refs,
+                                encs)}
                 # nrows deliberately = plen: expression evaluation must
                 # never depend on the logical count (pads are masked later)
                 return ev._conjunct_mask_eager(
@@ -1095,22 +1096,27 @@ class Planner:
             return None
         cols = [table.columns[n] for n in names]
         plen = table.plen
+        from nds_tpu.engine.column import enc_key, encs_equal
         key = (tuple(expr_key(c) for c in exprs), plen,
-               tuple((n, c.kind, int(c.data.shape[0]), c.valid is not None)
+               tuple((n, c.kind, int(c.data.shape[0]), c.valid is not None,
+                      str(c.data.dtype), enc_key(c.enc))
                      for n, c in zip(names, cols)))
         hit = cache.get(key)
         if hit is not None and all(h is c.dict_values
-                                   for h, c in zip(hit[0], cols)):
+                                   for h, c in zip(hit[0], cols)) and \
+                all(encs_equal(h, c.enc)
+                    for h, c in zip(hit[3], cols)):
             fn = hit[1]
             if fn is None:
                 return None
             return fn(tuple(c.data for c in cols),
                       tuple(c.valid for c in cols)), hit[2]
         dict_refs = tuple(c.dict_values for c in cols)
+        encs = tuple(c.enc for c in cols)
         kinds = tuple(c.kind for c in cols)
         ev = Planner({}, base_tables=set())
         meta: list = []
-        fn = jax.jit(build_impl(ev, names, kinds, dict_refs, meta))
+        fn = jax.jit(build_impl(ev, names, kinds, dict_refs, encs, meta))
         try:
             out = fn(tuple(c.data for c in cols),
                      tuple(c.valid for c in cols))
@@ -1123,12 +1129,12 @@ class Planner:
                 what, type(e).__name__, e)
             if len(cache) >= _MASK_FUSE_MAX:
                 cache.pop(next(iter(cache)))
-            cache[key] = (dict_refs, None, None)
+            cache[key] = (dict_refs, None, None, encs)
             return None
         m = list(meta)
         if len(cache) >= _MASK_FUSE_MAX:
             cache.pop(next(iter(cache)))
-        cache[key] = (dict_refs, fn, m)
+        cache[key] = (dict_refs, fn, m, encs)
         return out, m
 
     def _has_window(self, e) -> bool:
@@ -1170,14 +1176,15 @@ class Planner:
             return
         plen = table.plen
 
-        def build_impl(ev, names, kinds, dict_refs, meta):
+        def build_impl(ev, names, kinds, dict_refs, encs, meta):
             def impl(datas, valids):
-                tcols = {n: Column(k, d, v, dv) for n, k, d, v, dv in
-                         zip(names, kinds, datas, valids, dict_refs)}
+                tcols = {n: Column(k, d, v, dv, en) for n, k, d, v, dv, en
+                         in zip(names, kinds, datas, valids, dict_refs,
+                                encs)}
                 tctx = EvalCtx(DeviceTable(tcols, plen, plen=plen))
                 outs = [ev.eval_expr(e, tctx) for _, e in fusable]
                 meta.clear()
-                meta.extend((c.kind, c.dict_values) for c in outs)
+                meta.extend((c.kind, c.dict_values, c.enc) for c in outs)
                 return (tuple(c.data for c in outs),
                         tuple(c.valid for c in outs))
             return impl
@@ -1188,8 +1195,9 @@ class Planner:
         if got is None:
             return
         (datas, valids), meta = got
-        for (k, _), d, v, (kind, dv) in zip(fusable, datas, valids, meta):
-            ctx.window_values[k] = Column(kind, d, v, dv)
+        for (k, _), d, v, (kind, dv, en) in zip(fusable, datas, valids,
+                                                meta):
+            ctx.window_values[k] = Column(kind, d, v, dv, en)
 
     def _filter_conjuncts(self, table: DeviceTable, conjuncts) -> DeviceTable:
         if not conjuncts:
@@ -1239,6 +1247,7 @@ class Planner:
                 reason = "NDS_TPU_STREAM_EXEC=eager"
             outs = []
             n_chunks = 0
+            h2d = 0
             # a bound-bucket overflow discards a COMPLETED compiled run:
             # the rerun gets its own span name so tools/trace_report.py
             # can price the wasted pipeline work separately from ordinary
@@ -1251,6 +1260,12 @@ class Planner:
                            reason=reason or "replay-nested"):
                 for chunk in parts[keep].device_chunks(self):
                     n_chunks += 1
+                    # actual prefetch bytes of this scan (buffer metadata,
+                    # no sync): the eager loop uploads unencoded chunks
+                    h2d += sum(
+                        c.data.nbytes
+                        + (0 if c.valid is None else c.valid.nbytes)
+                        for c in chunk.columns.values())
                     sub = list(parts)
                     sub[keep] = chunk
                     with E.outer_match_collector() as omc:
@@ -1276,8 +1291,10 @@ class Planner:
                 # None = replay-nested fallback, accounted by the outer pass.
                 from nds_tpu.listener import record_stream_event
                 record_stream_event(parts[keep].alias, n_chunks,
-                                    E.sync_count() - syncs0, "eager", reason)
-                _obs.annotate(path="eager", chunks=n_chunks, reason=reason)
+                                    E.sync_count() - syncs0, "eager", reason,
+                                    bytes_h2d=h2d)
+                _obs.annotate(path="eager", chunks=n_chunks, reason=reason,
+                              bytesH2d=h2d)
             return result
 
     def _append_outer_extras(self, result, builds, bitmaps):
@@ -1394,7 +1411,7 @@ class Planner:
             # chunk-side columns must be NULLABLE in the output template:
             # the extras rows null-extend them at materialize time
             cols.setdefault(n, Column(c.kind, c.data, c.valid_mask(),
-                                      c.dict_values))
+                                      c.dict_values, c.enc))
         return DeviceTable(cols, n_pairs)
 
     def _join_parts(self, parts, join_preds, where_conjuncts, sources=None):
@@ -1807,7 +1824,7 @@ class Planner:
                     null = Column(kcol.kind,
                                   jnp.zeros(cap, dtype=kcol.data.dtype),
                                   jnp.zeros(cap, dtype=bool),
-                                  kcol.dict_values)
+                                  kcol.dict_values, kcol.enc)
                 post.group_values[kname] = null
                 post.grouping_flags[kname] = 1
         post.agg_values.update(agg_vals)
@@ -2204,6 +2221,7 @@ class Planner:
         amt = -iv.amount if negate else iv.amount
         if base.kind == "str":
             base = X.cast(base, "date")
+        base = E.plain_col(base)
         if iv.unit == "day":
             return Column("date", (base.data + amt).astype(base.data.dtype), base.valid)
         # month/year arithmetic via numpy calendar math on host (a whole-
@@ -2244,6 +2262,7 @@ class Planner:
         if e.negated and has_null:
             # ANSI: NOT IN with a NULL in the list is never true
             return Column("bool", jnp.zeros(len(col), dtype=bool))
+        col = E.plain_col(col)
         if col.kind == "str":
             res = X.fn_in_strings(col, [str(v) for v in values])
         elif col.kind == "f64":
@@ -2305,7 +2324,7 @@ class Planner:
             b = self.eval_expr(e.args[1], ctx)
             eq = X.compare("=", a, b)
             new_valid = a.valid_mask() & ~(eq.data.astype(bool) & eq.valid_mask())
-            return Column(a.kind, a.data, new_valid, a.dict_values)
+            return Column(a.kind, a.data, new_valid, a.dict_values, a.enc)
         if name in ("abs",):
             return X.fn_abs(self.eval_expr(e.args[0], ctx))
         if name == "round":
@@ -2336,6 +2355,7 @@ class Planner:
         raise ExecError(f"unsupported function {name}")
 
     def _date_part(self, col: Column, part: str) -> Column:
+        col = E.plain_col(col)
         def fetch():
             # host calendar math on the whole column — replay-logged
             days = np.asarray(col.data)
@@ -2646,7 +2666,8 @@ class Planner:
                             "scalar subquery returned more than one row")
 
                 E.defer_check(rt.nrows, check)
-                return Column(col.kind, data, valid, col.dict_values)
+                return Column(col.kind, data, valid, col.dict_values,
+                              col.enc)
             n_rt = E.count_int(rt.nrows)     # host semantics: exact count
             if n_rt == 0:
                 return X.literal(None, n)
@@ -2656,7 +2677,7 @@ class Planner:
             valid = None
             if col.valid is not None:
                 valid = jnp.broadcast_to(col.valid[0], (n,))
-            return Column(col.kind, data, valid, col.dict_values)
+            return Column(col.kind, data, valid, col.dict_values, col.enc)
         corr, stripped, residual = found
         if residual:
             raise ExecError("correlated subquery with non-equality correlation unsupported here")
@@ -2695,7 +2716,8 @@ class Planner:
         data = data.at[l_idx].set(jnp.take(val_col.data, r_idx), mode="drop")
         valid = valid.at[l_idx].set(jnp.take(val_col.valid_mask(), r_idx),
                                     mode="drop")
-        return Column(val_col.kind, data, valid, val_col.dict_values)
+        return Column(val_col.kind, data, valid, val_col.dict_values,
+                      val_col.enc)
 
     def _eval_quantified(self, e: A.QuantifiedCompare, ctx: EvalCtx) -> Column:
         n = ctx.table.plen
@@ -2716,7 +2738,7 @@ class Planner:
             return Column(red.kind, jnp.broadcast_to(red.data[0], (n,)),
                           None if red.valid is None
                           else jnp.broadcast_to(red.valid[0], (n,)),
-                          red.dict_values)
+                          red.dict_values, red.enc)
 
         if e.op in ("=", "<>"):
             # = ALL: every value equals lhs  <=>  min = lhs AND max = lhs

@@ -941,11 +941,17 @@ def test_mem_audit_corpus_finite_and_deterministic():
                    if s.partitions > 1}
     # query54 joined the set when its subquery conjuncts became
     # residual-planned filters: the graph turned provable and its
-    # whole-statement bound is past capacity, so it decomposes too
+    # whole-statement bound is past capacity, so it decomposes too.
+    # query17 LEFT the set when encoded columnar execution shrank its
+    # streamed row width: the whole-statement bound now fits capacity,
+    # so its static partition count dropped from 4 to 1 (asserted below)
     assert sorted(partitioned) == \
-        ["query17", "query24_part1", "query24_part2", "query25",
+        ["query24_part1", "query24_part2", "query25",
          "query29", "query54", "query64", "query72"]
     cap = hbm_capacity_bytes()
+    q17 = [s for r in reports if r.query == "query17" for s in r.scans]
+    assert q17 and all(s.partitions == 1 for s in q17)
+    assert any(s.provable and s.acc_bytes <= cap for s in q17)
     for q, s in partitioned.items():
         assert s.provable and s.part_bytes <= cap, (q, s)
         assert s.part_rows * s.partitions >= s.acc_rows, \

@@ -88,7 +88,7 @@ def test_two_streamed_tables_one_axis():
     assert resident.sql(sql).collect() == streamed.sql(sql).collect()
 
 
-def test_padded_chunks_capacity_edges():
+def test_padded_chunks_capacity_edges(monkeypatch):
     """ChunkedTable.padded_chunks at the capacity boundaries the compiled
     pipeline (and mem_audit's width model) depends on: exact power-of-two
     fits, one-past-the-boundary short chunks, non-power-of-two chunk_rows
@@ -131,9 +131,22 @@ def test_padded_chunks_capacity_edges():
     # pytree uniformity: same kinds, validity present on every column
     assert len({tuple((n, c[n].kind, c[n].valid is not None)
                       for n in c.column_names) for c in chunks}) == 1
-    # the widths mem_audit prices are exactly what a padded chunk holds
-    assert c["v"].data.dtype.itemsize + 1 == type_width("int64")
+    # width-model mirror, encoded execution ON (the default): the narrow
+    # int64 column uploads as an int16 FOR code that round-trips exactly,
+    # and string dictionary codes are unchanged
+    enc_col = chunks[0]["v"]
+    assert enc_col.enc is not None and enc_col.enc.mode == "for"
+    assert enc_col.data.dtype == np.int16
     assert chunks[0]["s"].data.dtype.itemsize + 1 == type_width("string")
+    np.testing.assert_array_equal(np.asarray(enc_col.plain().data)[:800],
+                                  np.arange(800))
+    # the NDS_TPU_ENCODED=0 escape hatch preserves today's path: plain
+    # widths are exactly what mem_audit's base model prices
+    monkeypatch.setenv("NDS_TPU_ENCODED", "0")
+    plain = list(ChunkedTable(tbl(100), chunk_rows=1024).padded_chunks())
+    assert plain[0]["v"].enc is None
+    assert plain[0]["v"].data.dtype.itemsize + 1 == type_width("int64")
+    monkeypatch.delenv("NDS_TPU_ENCODED")
     # single-row and empty tables still yield one full-capacity chunk
     for n in (1, 0):
         ct = ChunkedTable(tbl(n), chunk_rows=1024)
@@ -141,6 +154,108 @@ def test_padded_chunks_capacity_edges():
         assert len(chunks) == 1 and chunks[0].plen == 1024
         assert int(chunks[0].nrows) == n
         assert int(np.asarray(chunks[0]["v"].valid).sum()) == n
+
+
+def test_encoded_chunk_codecs():
+    """The encoded upload path (io/columnar.plan_column_codec through
+    padded_chunks): FOR base round-trip for offset int64/date domains,
+    the narrow-width overflow guard falling back to unencoded, sorted-
+    dict encoding for wide-span low-cardinality ints, shared-encoding
+    identity across chunks, and empty/single-row tables."""
+    from nds_tpu.io.columnar import plan_column_codec
+
+    n = 5000
+    rng = np.random.default_rng(7)
+    # span past int32 AND more distinct values than the dict codec
+    # admits (DICT_MAX_VALUES): no narrow width fits — the guard case
+    wide = np.arange(n) * (1 << 40) + rng.integers(0, 1 << 30, n)
+    lowcard = rng.choice([5, 10 ** 12, -3, 99], n)   # wide span, 4 values
+    offs = 5_000_000 + rng.integers(0, 900, n)   # FOR int16 after rebase
+    t = pa.table({
+        "offs": pa.array(offs, pa.int64()),
+        "wide": pa.array(wide, pa.int64()),
+        "lowcard": pa.array(lowcard, pa.int64()),
+        "d": pa.array((np.arange(n) % 400 + 10000).astype("int32"),
+                      pa.date32()),
+        "dec": pa.array([None] * n, pa.int64()),
+    })
+    ct = ChunkedTable(t, chunk_rows=1024)
+    chunks = list(ct.padded_chunks())
+    c0 = chunks[0]
+    # FOR round-trip: int16 offsets from the whole-table min
+    assert c0["offs"].enc is not None and c0["offs"].enc.mode == "for"
+    assert c0["offs"].data.dtype == np.int16
+    np.testing.assert_array_equal(
+        np.asarray(c0["offs"].plain().data)[:1024], offs[:1024])
+    # narrow-width overflow guard: the wide-span column stays unencoded
+    assert c0["wide"].enc is None
+    assert c0["wide"].data.dtype == np.int64
+    # sorted-dict codes for the wide-span low-cardinality column
+    assert c0["lowcard"].enc is not None and c0["lowcard"].enc.mode == "dict"
+    assert list(c0["lowcard"].enc.values) == [-3, 5, 99, 10 ** 12]
+    np.testing.assert_array_equal(
+        np.asarray(c0["lowcard"].plain().data)[:1024], lowcard[:1024])
+    # dates narrow too (the span is the sales window, not the calendar)
+    assert c0["d"].enc is not None and c0["d"].data.dtype == np.int16
+    np.testing.assert_array_equal(
+        np.asarray(c0["d"].plain().data)[:1024],
+        (np.arange(1024) % 400 + 10000))
+    # an all-null column encodes as trivial FOR (the static width model
+    # prices it narrow, so the runtime must never upload it wide)
+    assert c0["dec"].enc is not None and c0["dec"].data.dtype == np.int16
+    assert not np.asarray(c0["dec"].valid).any()
+    # shared-encoding identity across chunks: one Encoding object (a
+    # cache-key member, like the string dictionaries)
+    assert len({id(c["offs"].enc) for c in chunks}) == 1
+    assert len({id(c["lowcard"].enc.values) for c in chunks}) == 1
+    # empty and single-row tables still chunk cleanly
+    for m in (1, 0):
+        small = ChunkedTable(t.slice(0, m), chunk_rows=1024)
+        (chunk,) = list(small.padded_chunks())
+        assert int(chunk.nrows) == m and chunk.plen == 1024
+    # plan_column_codec rejects non-int kinds outright
+    assert plan_column_codec(pa.array(["x", "y"]), "string") is None
+
+
+def test_encoded_compiled_matches_unencoded_and_shrinks_h2d():
+    """Acceptance: A/B templates run the ENCODED compiled path bit-for-
+    bit equal to the decoded run under NDS_TPU_STREAM_STRICT=1, and
+    streamedScans reports bytes_h2d strictly below the unencoded upload
+    bytes on every encoded scan — the compression win is measured, not
+    asserted."""
+    import os
+
+    from nds_tpu.listener import drain_stream_events
+    from tests.test_synccount import (_STREAM_AB_QUERIES,
+                                      _chunked_star_session,
+                                      _forced_stream_partitions)
+
+    ab = [_STREAM_AB_QUERIES[0][0], _STREAM_AB_QUERIES[7][0]]
+    runs = {}
+    for flag in ("1", "0"):
+        old = os.environ.get("NDS_TPU_ENCODED")
+        os.environ["NDS_TPU_ENCODED"] = flag
+        try:
+            with _forced_stream_partitions():
+                s = _chunked_star_session(np.random.default_rng(42))
+                drain_stream_events()
+                rows, bytes_h2d = [], []
+                for q in ab:
+                    rows.append(s.sql(q).collect())
+                    events = drain_stream_events()
+                    assert [e.path for e in events] == ["compiled"], \
+                        (flag, q, events)
+                    bytes_h2d.append(events[0].bytes_h2d)
+                runs[flag] = (rows, bytes_h2d)
+        finally:
+            if old is None:
+                os.environ.pop("NDS_TPU_ENCODED", None)
+            else:
+                os.environ["NDS_TPU_ENCODED"] = old
+    assert runs["1"][0] == runs["0"][0], "encoded/decoded divergence"
+    for enc_b, plain_b in zip(runs["1"][1], runs["0"][1]):
+        assert 0 < enc_b < plain_b, \
+            f"encoded upload {enc_b} not below unencoded {plain_b}"
 
 
 def test_acc_ceiling_env_read_at_build_time(monkeypatch, tmp_path):

@@ -85,9 +85,12 @@ def git_changed_files():
 # schema the differential harnesses check the audits against — the
 # partition code paths (engine/stream.py, analysis/mem_audit.py,
 # listener StreamEvent fields) all rerun the corpus passes on change.
+# io/columnar.py holds the narrow-upload codec rules (encoded columnar
+# execution) that mem_audit's width model mirrors — encoding edits rerun
+# the corpus passes like any other engine-semantics change.
 _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/engine", "nds_tpu/schema.py",
-                 "nds_tpu/listener.py")
+                 "nds_tpu/listener.py", "nds_tpu/io/columnar.py")
 
 
 def run_passes(template_dir=None, changed=None, want_reports=False):

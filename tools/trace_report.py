@@ -6,8 +6,11 @@ Reads every ``*.trace.json`` a driver wrote (``nds_power.py --trace-dir``
 / ``NDS_BENCH_TRACE_DIR``) and prints:
 
 1. the per-query phase breakdown — self-time per phase (a parent span's
-   time minus its children), host-sync count, and the compile-vs-drive
-   split of the streamed chunk pipeline;
+   time minus its children), host-sync count, the compile-vs-drive
+   split of the streamed chunk pipeline, and the encoded-columnar
+   transfer accounting: logical vs actually-uploaded (encoded) bytes
+   per template plus the effective scan GB/s (logical bytes over the
+   stream span's wall time) — compression wins measured, not asserted;
 2. the top sync-charging host-read sites across the run (the first-class
    ``ops.host_read`` call-site tags — which engine lines pay the round
    trips);
@@ -98,12 +101,21 @@ def report(trace_dir, top=10):
         # sync slices are excluded from the span tree: their blocked time
         # belongs to the phase span that paid it, not to an "other" row
         spans = self_times([e for e in events if not is_sync(e)])
-        row = {"total_ms": 0.0, "syncs": 0, "phases": defaultdict(float)}
+        row = {"total_ms": 0.0, "syncs": 0, "phases": defaultdict(float),
+               "h2d": 0, "logical": 0, "stream_ms": 0.0}
         for e in spans:
             name = e["name"]
             args = e.get("args") or {}
             row["phases"][name if name in PHASES else "other"] += \
                 e["self"] / 1e3
+            if name == "stream":
+                # encoded-columnar accounting rides the stream span
+                # (engine/stream.py annotates bytesH2d/bytesLogical;
+                # the eager loop annotates bytesH2d only)
+                row["h2d"] += args.get("bytesH2d", 0) or 0
+                row["logical"] += args.get("bytesLogical",
+                                           args.get("bytesH2d", 0)) or 0
+                row["stream_ms"] += e["dur"] / 1e3
             if name == "stream.drive":
                 drive_ms += e["self"] / 1e3
                 drive_n += 1
@@ -135,16 +147,27 @@ def report(trace_dir, top=10):
             if any(r["phases"].get(p) for r in per_query.values())]
     if any(r["phases"].get("other") for r in per_query.values()):
         used.append("other")
+    any_bytes = any(r["logical"] for r in per_query.values())
+    byte_heads = " logical MB | h2d MB | eff GB/s |" if any_bytes else ""
     lines = [f"# trace report: {len(per_query)} queries from {trace_dir}",
              "",
              "| query | total ms | " + " | ".join(used) +
-             " | host syncs |",
-             "|---" * (len(used) + 3) + "|"]
+             " | host syncs |" + byte_heads,
+             "|---" * (len(used) + 3 + (3 if any_bytes else 0)) + "|"]
     for q in sorted(per_query):
         r = per_query[q]
         cells = " | ".join(f"{r['phases'].get(p, 0.0):.1f}" for p in used)
+        tail = ""
+        if any_bytes:
+            # effective GB/s: LOGICAL bytes served per second of streamed
+            # scan wall time — what the scan achieves in uncompressed
+            # terms (uploaded h2d bytes below logical = compression win)
+            gbs = (r["logical"] / (r["stream_ms"] / 1e3) / 1e9) \
+                if r["stream_ms"] else 0.0
+            tail = (f" {r['logical'] / 1e6:.1f} | {r['h2d'] / 1e6:.1f} | "
+                    f"{gbs:.2f} |")
         lines.append(f"| {q} | {r['total_ms']:.1f} | {cells} | "
-                     f"{r['syncs']} |")
+                     f"{r['syncs']} |" + tail)
     comp = sum(r["phases"].get("stream.compile", 0.0)
                for r in per_query.values())
     drive = sum(r["phases"].get("stream.drive", 0.0)
