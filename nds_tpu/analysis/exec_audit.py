@@ -139,6 +139,23 @@ from nds_tpu.sql.parser import ParseError, expr_key, parse
 # (ROADMAP "Streamed-path sync budget"; tests/test_synccount.py pins it)
 SYNC_BUDGET = 6
 
+# static COLLECTIVE budget of the sharded streamed pipeline
+# (NDS_TPU_STREAM_SHARDS > 1, engine/stream.py): per chunk, the only
+# collectives are the hash-exchange pass's all-to-alls — at most one per
+# uploaded buffer (data + validity per kept column) plus the partition-id
+# and validity planes, so <= 2 x scan columns + 2; the widest streamed
+# fact (catalog_sales, 34 columns) bounds the corpus at 70. At the single
+# materializing sync, ONE cross-shard reduce runs: an all-gather of the
+# per-shard counts, a psum of the overflow flags, a psum of the partition
+# histogram, and one psum-OR per deferred outer-build bitmap — a fixed
+# handful, gated at 8 (+1 per outer build is still far below). The
+# per-chunk program itself must contain ZERO collectives (every shard
+# works its own rows; builds ride replicated). Checked against runtime
+# trace-time accounting (StreamEvent.collectives) by
+# tools/exec_audit_diff.py under a forced multi-device mesh.
+COLLECTIVE_CHUNK_BUDGET = 72
+COLLECTIVE_FINAL_BUDGET = 8
+
 # >HBM binding model: the catalog tables bound as host-resident
 # ChunkedTables at the audited scale (SF10 with NDS_TPU_STREAM_BYTES=1.5e9
 # streams exactly these four; session.read_columnar_view decides at load
@@ -184,6 +201,14 @@ class ScanVerdict:
     mechanisms: tuple = ()     # multi-pass conversions serving this scan
     #                            ("streamed-subquery", "outer-gather",
     #                             "outer-build", "recorded-scalar")
+    shards: int = 1            # modeled mesh shard count
+    #                            (NDS_TPU_STREAM_SHARDS; 1 = single-device)
+    a2a_chunk: int = 0         # collective budget, per chunk: upper bound
+    #                            on the exchange pass's all-to-alls (0 =
+    #                            no exchange can run — unsharded, or no
+    #                            hashable equi keys)
+    coll_final: int = 0        # collective budget at the materializing
+    #                            sync: the one cross-shard reduce's ops
 
 
 @dataclass
@@ -212,7 +237,10 @@ class ExecReport:
                        "gate_bound": s.gate_bound,
                        "per_chunk": s.per_chunk,
                        "first_sight": s.first_sight,
-                       "mechanisms": list(s.mechanisms)}
+                       "mechanisms": list(s.mechanisms),
+                       "shards": s.shards,
+                       "a2a_chunk": s.a2a_chunk,
+                       "coll_final": s.coll_final}
                       for s in self.scans],
             "detail": self.detail,
         }
@@ -969,10 +997,14 @@ class ExecAuditor:
             # re-planned per execution — its table resolves once (the
             # inner plan's own costs are subq_cost).
             n_resid = sum(len(_subquery_nodes(c)) for c in subq)
+            shards, a2a_chunk, coll_final = self._collective_budget(
+                parts, keep, conjuncts, cost)
             v = ScanVerdict(parts[keep].alias, parts[keep].source or "?",
                             True, (), gate_bound=1,
                             first_sight=len(pk_dims) + 1,
-                            mechanisms=tuple(mechanisms))
+                            mechanisms=tuple(mechanisms),
+                            shards=shards, a2a_chunk=a2a_chunk,
+                            coll_final=coll_final)
             cost.fixed += 1 + subq_cost.fixed + n_resid
             cost.first_sight += v.first_sight + subq_cost.first_sight
         else:
@@ -1006,6 +1038,45 @@ class ExecAuditor:
                 local_scans.append(w)
                 verdicts.append(w)
         return verdicts
+
+    def _collective_budget(self, parts, keep, conjuncts, cost):
+        """``(shards, a2a_chunk, coll_final)`` of one compiled streamed
+        scan — the static collective budget of the sharded pipeline
+        (``NDS_TPU_STREAM_SHARDS``; all zeros when unsharded).
+
+        ``a2a_chunk`` is an UPPER bound on the per-chunk exchange pass's
+        all-to-alls: the pass MAY run only when the graph has hashable
+        equi keys on the streamed slot (``stream_partition_keys`` — the
+        same predicate the executor's partition/exchange trigger uses),
+        and it exchanges at most every uploaded buffer (data + validity
+        per pruned column) plus the partition-id and validity planes.
+        ``coll_final`` bounds the one cross-shard materialize reduce:
+        count all-gather + overflow psum + histogram psum + one psum-OR
+        per deferred outer-build bitmap. The per-chunk program itself is
+        collective-free by construction — every explicit collective the
+        runtime issues is trace-time counted, and
+        ``tools/exec_audit_diff.py`` fails when the measured
+        ``StreamEvent.collectives`` ever exceeds
+        ``a2a_chunk x chunks + coll_final``."""
+        from nds_tpu.analysis.mem_audit import (stream_partition_keys,
+                                                stream_shards_env)
+        shards = stream_shards_env()
+        if shards <= 1:
+            return 1, 0, 0
+        part_cols = [{c for cols in p.cols.values() for c in cols}
+                     for p in parts]
+        sources = [p.source for p in parts]
+        keys = stream_partition_keys(part_cols, sources, keep, conjuncts)
+        source = parts[keep].source or ""
+        cols = self.catalog.get(source, {})
+        n_cols = len(cols) or 1
+        if cost.needed is not None and cols:
+            kept = {c for c in cols if c in cost.needed}
+            if kept and len(kept) < len(cols):
+                n_cols = len(kept)
+        a2a_chunk = (2 * n_cols + 2) if keys else 0
+        n_builds = sum(1 for p in parts if p.outer_mech == "outer-build")
+        return shards, a2a_chunk, 3 + n_builds
 
     # -- subqueries inside expressions --------------------------------------
 
@@ -1098,6 +1169,18 @@ def reports_to_findings(reports) -> list:
                     f"{s.gate_bound} (> {SYNC_BUDGET}): the compiled "
                     "pipeline would exceed the streamed-path budget every "
                     "execution"))
+            if s.compiled and s.shards > 1 and (
+                    s.a2a_chunk > COLLECTIVE_CHUNK_BUDGET
+                    or s.coll_final > COLLECTIVE_FINAL_BUDGET
+                    + sum(1 for m in s.mechanisms if m == "outer-build")):
+                findings.append(Finding(
+                    r.file, r.query, "collective-budget", "error",
+                    f"streamed scan {s.table!r} has a static collective "
+                    f"budget of {s.a2a_chunk}/chunk + {s.coll_final} at "
+                    f"materialize (> {COLLECTIVE_CHUNK_BUDGET}/"
+                    f"{COLLECTIVE_FINAL_BUDGET}): the sharded pipeline "
+                    "would pay more than one exchange per chunk or more "
+                    "than the single cross-shard reduce"))
     return findings
 
 
@@ -1124,9 +1207,11 @@ def format_stream_report(reports) -> str:
             if s.compiled:
                 mech = f" [{','.join(s.mechanisms)}]" if s.mechanisms \
                     else ""
+                shard = f" S={s.shards} coll<={s.a2a_chunk}/ch+" \
+                    f"{s.coll_final}" if s.shards > 1 else ""
                 bits.append(f"{s.table}: compiled{mech} "
                             f"gate={s.gate_bound}"
-                            f"(+{s.first_sight} first-sight)")
+                            f"(+{s.first_sight} first-sight){shard}")
             else:
                 bits.append(f"{s.table}: eager [{','.join(s.reasons)}] "
                             f"{s.per_chunk}/chunk")

@@ -42,6 +42,23 @@ with ``# nds-lint: ignore[rule]`` on the flagged line or the line above):
   (``obs``/``_obs``/``obs_trace``), any ``nds_tpu.obs`` import alias,
   and bare names from-imported from the obs package — an unrelated
   ``.span()`` (``re.Match.span()``) or a local helper does not.
+* ``host-sync-in-shard-map`` — a host-sync primitive, an
+  ``ops.host_read``-charging call (``host_read``, ``timed_read``,
+  ``guarded_scalar_read``, ``host_sync``, ``count_int``,
+  ``resolve_counts``, ``.to_int()``, ``.item()``, ``device_get``,
+  ``np.asarray``), or an ``obs.span(...)`` trace context inside a
+  function passed to ``shard_map``/``pjit``. A shard_map body is traced
+  once and runs as one SPMD program on every mesh device: a host read
+  there is at best a tracer error and at worst a per-dispatch full-mesh
+  barrier, and a span would clock the trace, not the execution (the
+  ``span-in-jit`` hazard, but the runtime null-span guard cannot see a
+  shard_map body that is traced outside replay mode). The rule resolves
+  the body by name — any function whose name is passed as the first
+  argument to a ``shard_map``/``pjit`` call in the module — and also
+  sees ONE level down into module-local helpers, like
+  ``chunk-loop-host-sync``. Error severity: the sharded streamed
+  pipeline's collective budget proves these bodies sync-free, so a
+  violation is a correctness bug, not a perf note.
 * ``chunk-loop-host-sync`` — a host-sync primitive (``.item()``,
   ``np.asarray``/``np.array``, ``device_get``, ``.to_int()``, or the
   engine's ``host_sync``/``count_int``/``resolve_counts``) lexically
@@ -72,6 +89,9 @@ _TIME_FUNCS = {"time", "perf_counter", "perf_counter_ns", "monotonic"}
 _CHUNK_ITER_FUNCS = {"device_chunks", "padded_chunks"}
 # engine entry points that resolve a device scalar on host
 _ENGINE_SYNC_FUNCS = {"host_sync", "count_int", "resolve_counts"}
+# ops.host_read-charging entry points (every counted device->host read
+# funnels through host_read; these are the call forms code reaches it by)
+_HOST_READ_FUNCS = {"host_read", "timed_read", "guarded_scalar_read"}
 
 
 def _sync_primitive(node) -> str | None:
@@ -140,6 +160,26 @@ def _collect_sync_helpers(tree) -> dict:
     return helpers
 
 
+def _collect_shard_bodies(tree) -> set:
+    """Names of functions passed as the first argument to a
+    ``shard_map``/``pjit`` call anywhere in the module (including the
+    engine's ``shard_map_compat`` shim) — the bodies the
+    ``host-sync-in-shard-map`` rule polices. Name-based resolution: the
+    conventional pattern defines the body and wraps it in the same
+    scope, so a name collision only widens coverage."""
+    bodies = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name in ("shard_map", "shard_map_compat", "pjit") and \
+                node.args and isinstance(node.args[0], ast.Name):
+            bodies.add(node.args[0].id)
+    return bodies
+
+
 def _is_jit_decorator(dec) -> tuple[bool, set]:
     """(is jax.jit, static arg positions/names) for one decorator node."""
     static: set = set()
@@ -169,9 +209,12 @@ def _is_jit_decorator(dec) -> tuple[bool, set]:
 
 class _Lint(ast.NodeVisitor):
     def __init__(self, path: str, rel: str, source: str,
-                 sync_helpers: dict | None = None):
+                 sync_helpers: dict | None = None,
+                 shard_bodies: set | None = None):
         self.rel = rel
         self.sync_helpers = sync_helpers or {}
+        self.shard_bodies = shard_bodies or set()
+        self.shard_depth = 0         # inside a shard_map/pjit body
         self.lines = source.splitlines()
         self.findings: list = []
         self.scope_stack = ["<module>"]
@@ -253,6 +296,8 @@ class _Lint(ast.NodeVisitor):
             traced = set()
         self.jit_params.append(traced)
         self.param_use_stack.append((names, {}))
+        is_shard = node.name in self.shard_bodies
+        self.shard_depth += is_shard
         saved_loop = self.loop_depth
         saved_chunk = self.chunk_loop_depth
         self.loop_depth = 0
@@ -260,6 +305,7 @@ class _Lint(ast.NodeVisitor):
         self.generic_visit(node)
         self.loop_depth = saved_loop
         self.chunk_loop_depth = saved_chunk
+        self.shard_depth -= is_shard
         self.jit_params.pop()
         if jit_static is not None:
             self.jit_depth -= 1
@@ -364,8 +410,53 @@ class _Lint(ast.NodeVisitor):
                        "device_chunks() loop: one host sync per chunk "
                        "hidden one level down", node.lineno)
 
+    def _check_shard_map_sync(self, node) -> None:
+        """Flag host reads / spans inside a shard_map or pjit body: the
+        body is one traced SPMD program — a host read there is a tracer
+        hazard and a full-mesh barrier, a span clocks the trace."""
+        if not self.shard_depth:
+            return
+        f = node.func
+        what = _sync_primitive(node)
+        if what is None:
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in _HOST_READ_FUNCS:
+                what = f"{f.attr}()"
+            elif isinstance(f, ast.Name) and f.id in _HOST_READ_FUNCS:
+                what = f"{f.id}()"
+        is_span = (isinstance(f, ast.Attribute) and f.attr == "span"
+                   and isinstance(f.value, ast.Name)
+                   and f.value.id in self.obs_aliases) or \
+            (isinstance(f, ast.Name) and f.id in self.span_funcs)
+        if what or is_span:
+            self._emit("host-sync-in-shard-map", "error",
+                       f"{what or 'obs.span(...)'} inside a shard_map/"
+                       "pjit body: the body is one traced SPMD program — "
+                       "host reads are tracer hazards and full-mesh "
+                       "barriers; resolve on host before the dispatch or "
+                       "ride the overflow/collective channels",
+                       node.lineno)
+            return
+        # one level down: a module-local helper whose body syncs directly
+        key = None
+        if isinstance(f, ast.Name):
+            key = (None, f.id)
+        elif isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and self.class_stack:
+            key = (self.class_stack[-1], f.attr)
+        hit = key is not None and self.sync_helpers.get(key)
+        if hit:
+            lineno, prim = hit
+            self._emit("host-sync-in-shard-map", "error",
+                       f"{key[1]}() (defined in this module, syncs via "
+                       f"{prim} at line {lineno}) called inside a "
+                       "shard_map/pjit body: one host sync per dispatch "
+                       "hidden one level down", node.lineno)
+
     def visit_Call(self, node):
         self._check_chunk_loop_sync(node)
+        self._check_shard_map_sync(node)
         f = node.func
         if isinstance(f, ast.Attribute):
             owner = f.value.id if isinstance(f.value, ast.Name) else None
@@ -597,7 +688,8 @@ def lint_file(path: str, rel: str | None = None) -> list:
     except SyntaxError as e:
         return [Finding(rel, "<module>", "syntax-error", "error",
                         str(e), e.lineno or 0)]
-    lint = _Lint(path, rel, source, _collect_sync_helpers(tree))
+    lint = _Lint(path, rel, source, _collect_sync_helpers(tree),
+                 _collect_shard_bodies(tree))
     lint.visit(tree)
     lint.finish()
     return lint.findings

@@ -457,6 +457,40 @@ def stream_skew_factor() -> int:
     return max(int(os.environ.get("NDS_TPU_STREAM_SKEW", "2")), 1)
 
 
+def stream_shards_env() -> int:
+    """``NDS_TPU_STREAM_SHARDS``: shard count of the streamed pipeline's
+    device mesh (rounded up to a power of two; <= 1 disables sharding).
+    Read at model/pipeline BUILD time like the partition knob. The audit
+    models the requested count; the runtime additionally requires that
+    many local devices (``parallel.exchange.stream_mesh``) and falls back
+    to 1 otherwise — the differential harness closes that gap by checking
+    ``StreamEvent.shards`` against the model."""
+    env = os.environ.get("NDS_TPU_STREAM_SHARDS")
+    return _pow2_at_least(int(env)) if env else 1
+
+
+def shard_row_bound(rows: int, n_shards: int, n_partitions: int, k: int,
+                    fanout: int, skew: int | None = None) -> int:
+    """Per-shard survivor-row bound of a mesh-sharded streamed graph:
+    the structural bound of one shard's skew-factored row share —
+    ``rows/shards × skew`` through the fan-out exponent. Composes with
+    grace-style partitioning (``n_partitions`` > 1): the partition share
+    re-shares over the mesh, each level keeping its own skew allowance.
+    Sound under the skew assumption; the runtime enforces it with
+    per-shard overflow flags (overflow ⇒ eager rerun), exactly like
+    :func:`partition_row_bound`. Shared by the audit and
+    ``engine/stream.py`` — one definition, no drift."""
+    if skew is None:
+        skew = stream_skew_factor()
+    rows = max(int(rows), 1)
+    share = rows
+    if n_partitions > 1:
+        share = min(share, -(-share // int(n_partitions)) * int(skew))
+    if n_shards > 1:
+        share = min(share, -(-share // int(n_shards)) * int(skew))
+    return structural_row_bound(share, k, fanout)
+
+
 def partition_row_bound(rows: int, n_partitions: int, k: int, fanout: int,
                         skew: int | None = None) -> int:
     """Per-partition survivor-row bound of a hash-partitioned streamed
@@ -698,6 +732,10 @@ class MemModel:
         # partitioned accumulation knobs (same build-time env discipline)
         self.partitions = stream_partitions_env()  # None = proof-chosen
         self.skew = stream_skew_factor()
+        # mesh-sharded execution knob (NDS_TPU_STREAM_SHARDS): the per-
+        # shard bound divides the survivor share over the mesh exactly
+        # like the partition share rule (shard_row_bound)
+        self.shards = stream_shards_env()
         if catalog is None:
             catalog = {
                 t: {f.name.lower(): type_width(f.type) for f in fields}
@@ -800,6 +838,15 @@ class ScanBound:
     partitions: int = 1        # grace-style partition count (1 = whole)
     part_rows: int | None = None   # per-partition accumulator row bound
     part_bytes: int | None = None  # part_rows x streamed-graph row width
+    shards: int = 1            # mesh shard count (NDS_TPU_STREAM_SHARDS)
+    shard_rows: int | None = None  # per-shard survivor-row bound across
+    #                                partitions (rows/shards x skew through
+    #                                the fan-out — what StreamEvent's
+    #                                shard_rows evidence is checked against)
+    shard_bytes: int | None = None  # per-(partition, shard) accumulator
+    #                                 unit bound x row width — the
+    #                                 allocation unit a sharded pipeline's
+    #                                 per-shard overflow flags enforce
 
     @property
     def provable(self) -> bool:
@@ -836,6 +883,11 @@ class MemReport:
                        else int(s.part_rows),
                        "part_bytes": None if s.part_bytes is None
                        else int(s.part_bytes),
+                       "shards": int(s.shards),
+                       "shard_rows": None if s.shard_rows is None
+                       else int(s.shard_rows),
+                       "shard_bytes": None if s.shard_bytes is None
+                       else int(s.shard_bytes),
                        "provable": s.provable} for s in self.scans],
             "detail": self.detail,
         }
@@ -1432,10 +1484,29 @@ class MemAuditor:
             # eager loop: survivors concatenate up to the graph bound
             acc_rows = acc_bytes = None
             survivors = joined_rows
+        # mesh-sharded execution (NDS_TPU_STREAM_SHARDS): the per-shard
+        # survivor bound is the share rule applied over the mesh —
+        # rows/shards x skew through the fan-out — and the allocation
+        # unit is the (partition, shard) composition. The eager loop
+        # never shards, so unprovable scans keep shards=1.
+        n_shards, srows, sbytes = 1, None, None
+        if k is not None and self.model.shards > 1:
+            n_shards = self.model.shards
+            srows = min(acc_rows,
+                        shard_row_bound(kept.rows, n_shards, 1, k,
+                                        self.model.fanout, self.model.skew))
+            unit = min(part_rows if part_rows is not None else acc_rows,
+                       shard_row_bound(kept.rows, n_shards, n_parts, k,
+                                       self.model.fanout, self.model.skew))
+            if self.model.acc_ceiling is not None:
+                srows = min(srows, self.model.acc_ceiling)
+                unit = min(unit, self.model.acc_ceiling)
+            sbytes = unit * merged.width
         sb = ScanBound(kept.alias, kept.source or "?", kept.rows, k,
                        acc_rows, acc_bytes, chunk_bytes,
                        partitions=n_parts, part_rows=part_rows,
-                       part_bytes=part_bytes)
+                       part_bytes=part_bytes, shards=n_shards,
+                       shard_rows=srows, shard_bytes=sbytes)
         cost.scans.append(sb)
         # working set: two chunks in flight + the survivor accumulator(s)
         # (partitioned: every partition's proof-sized accumulator is live
@@ -1525,6 +1596,18 @@ def reports_to_findings(reports, capacity_bytes: int | None = None) -> list:
         for s in r.scans:
             if not s.provable:
                 continue
+            if s.shards > 1 and s.shard_bytes is not None:
+                # sharded pipeline: the allocation unit is one
+                # (partition, shard) accumulator — the bound the per-shard
+                # overflow flags enforce
+                if s.shard_bytes > cap:
+                    findings.append(Finding(
+                        r.file, r.query, "hbm-capacity", "error",
+                        f"streamed scan {s.table!r} per-shard accumulator "
+                        f"bound {s.shard_bytes:,} B ({s.shards} shards x "
+                        f"{s.partitions} partitions) exceeds the "
+                        f"configured HBM capacity {cap:,} B"))
+                continue
             if s.partitions > 1 and s.part_bytes is not None:
                 if s.part_bytes > cap:
                     findings.append(Finding(
@@ -1569,7 +1652,14 @@ def format_mem_report(reports) -> str:
         worst = max(worst, r.peak_bytes)
         bits = []
         for s in r.scans:
-            if s.provable and s.partitions > 1:
+            if s.provable and s.shards > 1:
+                bits.append(f"{s.table}: S={s.shards}"
+                            + (f" x P={s.partitions}"
+                               if s.partitions > 1 else "")
+                            + f" x {_human(s.shard_bytes)}/shard "
+                            f"({s.shard_rows:,} rows/shard, "
+                            f"k={s.fanout_k})")
+            elif s.provable and s.partitions > 1:
                 bits.append(f"{s.table}: P={s.partitions} x "
                             f"{_human(s.part_bytes)}/part "
                             f"({s.part_rows:,} rows/part, k={s.fanout_k})")

@@ -89,6 +89,29 @@ chosen statically from the proof (``mem_audit.choose_partitions``) and
 joins the pipeline-cache key; partition count 1 is byte-for-byte
 today's unpartitioned pipeline.
 
+SHARDED execution (``NDS_TPU_STREAM_SHARDS`` > 1, with that many local
+devices): the one compiled per-chunk program runs under ``shard_map``
+over a 1-D device mesh — every padded chunk's row range splits
+contiguously across the shards, dimension-side parts/operands/residuals
+ride replicated (the broadcast-join side of the exchange choice), and
+each shard accumulates survivors into its OWN proof-sized slice of the
+donated accumulators (per-shard overflow flags enforce the per-shard
+bound of ``mem_audit.shard_row_bound``). When the graph is ALSO
+partitioned (fan-out joins — the case where a join's keys are not
+co-partitioned with an arbitrary row split), a per-chunk EXCHANGE pass
+hash-routes rows over ICI with the ``parallel/exchange.py`` all-to-all
+primitives so each shard owns a key range (encoded codes ride the wire,
+so the exchange moves the narrow representation); ``NDS_TPU_STREAM_
+EXCHANGE=0`` keeps the local partition pass instead. ONE cross-shard
+reduce (all-gather of per-shard counts + psum of overflow flags /
+histogram / outer-build bitmaps) runs at the single materializing sync,
+so the <=6-host-sync budget holds at any shard count and the explicit
+collective count per pipeline pass is a static budget
+(``exec_audit``), checked against the trace-time collective accounting
+of ``parallel.exchange.collective_trace`` via ``StreamEvent.collectives``
+/ ``bytes_ici``. Shard count 1 is byte-for-byte the single-device
+pipeline.
+
 Env knobs (all read at pipeline-BUILD time, never frozen at
 import): ``NDS_TPU_STREAM_EXEC`` (compiled|eager),
 ``NDS_TPU_STREAM_ACC_ROWS`` (explicit hard accumulator ceiling / escape
@@ -97,7 +120,11 @@ hatch, applied per partition; unset = proof-sized),
 allowance, default 4), ``NDS_TPU_HBM_BYTES`` (capacity model, default
 16 GiB), ``NDS_TPU_STREAM_PARTITIONS`` (pin the partition count; unset =
 proof-chosen, <=1 disables), ``NDS_TPU_STREAM_SKEW`` (hash-skew safety
-factor of the per-partition bound, default 2).
+factor of the per-partition and per-shard bounds, default 2),
+``NDS_TPU_STREAM_SHARDS`` (mesh shard count; <=1 or too few local
+devices = single-device), ``NDS_TPU_STREAM_MESH_AXIS`` (mesh axis name,
+default ``shard``), ``NDS_TPU_STREAM_EXCHANGE`` (0 disables the
+partitioned hash-exchange pass).
 """
 
 from __future__ import annotations
@@ -253,6 +280,26 @@ def _hbm_bytes() -> int:
     except Exception:
         return 16 << 30
 
+
+def _shard_plan(chunk_cap: int):
+    """``(n_shards, mesh, axis)`` of the pipeline being built: >1 only
+    when ``NDS_TPU_STREAM_SHARDS`` asks for a power-of-two count this
+    process can serve (enough local devices, chunk capacity divisible).
+    Statically derived — the count joins the pipeline-cache key via the
+    env knob."""
+    try:
+        from nds_tpu.analysis.mem_audit import stream_shards_env
+        from nds_tpu.parallel.exchange import stream_mesh, stream_mesh_axis
+        n = stream_shards_env()
+        if n <= 1 or chunk_cap % n or chunk_cap // n < 1:
+            return 1, None, None
+        mesh = stream_mesh(n)
+        if mesh is None:
+            return 1, None, None
+        return n, mesh, stream_mesh_axis()
+    except Exception:
+        return 1, None, None
+
 # compiled pipelines are cached across statements (a Power Run executes
 # each query text 2-4 times); bounded FIFO, identity-validated on hit.
 # Mutations take the lock: concurrent Throughput streams share the cache.
@@ -365,7 +412,8 @@ class StreamPipeline:
                  operands, out_template, acc_cap, part_refs,
                  n_partitions=1, key_slots=(), outer_meta=(),
                  residuals=(), resid_specs=(), build_slots=(),
-                 name_catalog=None):
+                 name_catalog=None, n_shards=1, mesh=None,
+                 mesh_axis="shard", exchange=False, cap_ex=0):
         self.chunk_spec = chunk_spec      # ((aliased name, kind, dict), ...)
         self.chunk_cap = chunk_cap
         self.part_specs = part_specs      # specs of non-streamed parts
@@ -392,8 +440,29 @@ class StreamPipeline:
         self.resid_specs = tuple(resid_specs)
         self.build_slots = tuple(build_slots)
         self.name_catalog = dict(name_catalog or {})
+        # sharded execution: the per-chunk program runs under shard_map
+        # over this 1-D local-device mesh; acc_cap is then the PER-SHARD
+        # accumulator capacity. ``exchange`` turns on the per-chunk
+        # hash-exchange pass (partitioned graphs), with ``cap_ex`` the
+        # per-(source shard, destination) bucket capacity.
+        self.n_shards = n_shards
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
+        self.exchange = exchange
+        self.cap_ex = cap_ex
+        # per-shard physical chunk length the compiled program sees
+        self.body_plen = chunk_cap if n_shards == 1 else \
+            (n_shards * cap_ex if exchange else chunk_cap // n_shards)
         self.jitted = None
         self._pid_jit = None
+        self._exch_jit = None
+        self._reduce_jit = None
+        # explicit-collective accounting per compiled program, captured
+        # at trace time (parallel.exchange.collective_trace) on the first
+        # dispatch — the runtime evidence of the static collective budget
+        self.coll_chunk = None
+        self.coll_exchange = None
+        self.coll_reduce = None
         # first jitted dispatch traces+compiles the per-chunk program;
         # the trace layer labels that dispatch "stream.compile"
         self.traced_once = False
@@ -415,27 +484,33 @@ class StreamPipeline:
         n_builds = len(self.build_slots)
         name_cat = self.name_catalog
 
+        body_plen = self.body_plen
+
         def traced(chunk_flat, n_dev, parts_flat, ops_flat, acc,
-                   resid_flat, pids=None, part_id=None):
+                   resid_flat, pids=None, part_id=None, live=None):
             acc_datas, acc_valids, acc_n, acc_ovf, acc_outer = acc
             cols, i = {}, 0
             for (aname, kind, dv, cenc) in chunk_spec:
                 cols[aname] = Column(kind, chunk_flat[i], chunk_flat[i + 1],
                                      dv, cenc)
                 i += 2
-            chunk = DeviceTable(cols, E.DeviceCount(n_dev, chunk_cap),
-                                plen=chunk_cap)
+            chunk = DeviceTable(cols, E.DeviceCount(n_dev, body_plen),
+                                plen=body_plen)
+            mask = live
             if pids is not None:
-                # partition mask BEFORE the recorded graph: a lazy
-                # compact keeps the chunk's physical shape and bound
-                # (plen=chunk_cap), so the recorded host-read log stays
-                # valid for every (chunk, partition) pair. Under its own
-                # stream-bounds region: at production chunk sizes
-                # (chunk_cap > NDS_TPU_LAZY_SHRINK_ROWS) compact_table's
+                pm = pids == part_id
+                mask = pm if mask is None else (mask & pm)
+            if mask is not None:
+                # partition/exchange mask BEFORE the recorded graph: a
+                # lazy compact keeps the chunk's physical shape and bound
+                # (plen=body_plen), so the recorded host-read log stays
+                # valid for every (chunk, partition, shard) combination.
+                # Under its own stream-bounds region: at production chunk
+                # sizes (plen > NDS_TPU_LAZY_SHRINK_ROWS) compact_table's
                 # adaptive resolve would otherwise host-sync on a tracer
                 # and silently divert the whole pipeline to eager
                 with E.stream_bounds():
-                    chunk = E.compact_table(chunk, pids == part_id)
+                    chunk = E.compact_table(chunk, mask)
             sub, pi = [], 0
             for j in range(len(part_specs) + 1):
                 if j == keep:
@@ -503,26 +578,198 @@ class StreamPipeline:
         # (chunk in flight) + (chunk uploading) + ONE accumulator copy
         # per partition (the partition mask routes each dispatch to its
         # own accumulator, donated through)
-        self.jitted = jax.jit(traced, donate_argnums=(4,))
+        if self.n_shards == 1:
+            self.jitted = jax.jit(traced, donate_argnums=(4,))
 
-        if n_partitions > 1:
+            if n_partitions > 1:
+                P = n_partitions
+
+                def pid_fn(chunk_flat, n_dev, hist):
+                    h = jnp.full((chunk_cap,), 2166136261, dtype=jnp.uint32)
+                    for s in key_slots:
+                        h = _hash_mix(h, chunk_flat[s])
+                    pids = (h & jnp.uint32(P - 1)).astype(jnp.int32)
+                    live = jnp.arange(chunk_cap) < n_dev
+                    counts = jnp.bincount(jnp.where(live, pids, P),
+                                          length=P + 1)[:P]
+                    return pids, hist + counts.astype(hist.dtype)
+
+                # the extra jitted partition pass: per-row partition ids +
+                # the device-resident input histogram (donated through) —
+                # no host syncs anywhere in it
+                self._pid_jit = jax.jit(pid_fn, donate_argnums=(2,))
+            return self
+
+        # ---- sharded compile: the SAME traced body under shard_map ----
+        from jax.sharding import PartitionSpec as PSpec
+        from nds_tpu.parallel.exchange import shard_map_compat
+        S, axis = self.n_shards, self.mesh_axis
+        shard_plen = body_plen
+        contiguous = not self.exchange
+        row, rep = PSpec(axis), PSpec()
+
+        def shard_body(chunk_flat, n_dev, parts_flat, ops_flat, acc,
+                       resid_flat, pids, part_id, live):
+            # contiguous row split: shard s owns rows [s*plen, (s+1)*plen)
+            # of the chunk, so its live count derives from the global one
+            # (no collective). Exchanged chunks carry liveness in ``live``
+            # instead — every physical slot is in range, the mask decides.
+            if contiguous:
+                s = jax.lax.axis_index(axis).astype(jnp.int64)
+                n_local = jnp.clip(n_dev - s * shard_plen, 0, shard_plen)
+            else:
+                n_local = jnp.asarray(shard_plen, dtype=jnp.int64)
+            return traced(chunk_flat, n_local, parts_flat, ops_flat, acc,
+                          resid_flat, pids, part_id, live)
+
+        # accumulators are row-sharded (each shard scatters into its own
+        # acc_cap slice); un-valided columns keep their replicated scalar
+        # placeholder. Parts/operands/residuals ride replicated — the
+        # broadcast-join side of the exchange choice.
+        acc_spec = (tuple(row for _ in names),
+                    tuple(row if v else rep for v in valided),
+                    row, row, tuple(row for _ in self.build_slots))
+        in_specs = (row, rep, rep, rep, acc_spec, rep, row, rep, row)
+        sm = shard_map_compat(shard_body, self.mesh, in_specs, acc_spec)
+        self.jitted = jax.jit(sm, donate_argnums=(4,))
+
+        if self.exchange:
+            self._exch_jit = self._make_exchange()
+        elif n_partitions > 1:
             P = n_partitions
 
             def pid_fn(chunk_flat, n_dev, hist):
-                h = jnp.full((chunk_cap,), 2166136261, dtype=jnp.uint32)
-                for s in key_slots:
-                    h = _hash_mix(h, chunk_flat[s])
+                s = jax.lax.axis_index(axis).astype(jnp.int64)
+                n_local = jnp.clip(n_dev - s * shard_plen, 0, shard_plen)
+                h = jnp.full((shard_plen,), 2166136261, dtype=jnp.uint32)
+                for ks in key_slots:
+                    h = _hash_mix(h, chunk_flat[ks])
                 pids = (h & jnp.uint32(P - 1)).astype(jnp.int32)
-                live = jnp.arange(chunk_cap) < n_dev
+                live = jnp.arange(shard_plen) < n_local
                 counts = jnp.bincount(jnp.where(live, pids, P),
                                       length=P + 1)[:P]
-                return pids, hist + counts.astype(hist.dtype)
+                return pids, hist + counts.astype(hist.dtype).reshape(
+                    hist.shape)
 
-            # the extra jitted partition pass: per-row partition ids +
-            # the device-resident input histogram (donated through) —
-            # no host syncs anywhere in it
-            self._pid_jit = jax.jit(pid_fn, donate_argnums=(2,))
+            sm_pid = shard_map_compat(pid_fn, self.mesh,
+                                      (row, rep, row), (row, row))
+            self._pid_jit = jax.jit(sm_pid, donate_argnums=(2,))
+        self._reduce_jit = self._make_reduce()
         return self
+
+    def _make_exchange(self):
+        """Jitted per-chunk hash-EXCHANGE pass of a sharded partitioned
+        pipeline: each shard hashes its contiguous row slice on the
+        graph's equi keys (the same hash the partition ids use), packs
+        rows into per-destination-shard buckets, and the
+        ``parallel/exchange.py`` all-to-all routes them so every shard
+        owns a key range — the repartition a join needs when its keys
+        are not co-partitioned with the arbitrary upload split. Returns
+        the exchanged buffers + validity + partition ids + the updated
+        per-shard histogram and overflow flag (a bucket past ``cap_ex``
+        drops rows on device ⇒ the flag forces the eager rerun). No host
+        syncs anywhere in it; its collectives are counted at trace time
+        against the static budget."""
+        from jax.sharding import PartitionSpec as PSpec
+        from nds_tpu.parallel.exchange import (all_to_all_exchange,
+                                               shard_map_compat)
+        S, P = self.n_shards, self.n_partitions
+        axis = self.mesh_axis
+        shard_plen = self.chunk_cap // S
+        cap_ex = self.cap_ex
+        key_slots = self.key_slots
+        pshift = max(P.bit_length() - 1, 0)      # partition ids use the
+        #                                          low bits; shard routing
+        #                                          the next log2(S) bits
+
+        def exch_body(chunk_flat, n_dev, hist, ovf):
+            s = jax.lax.axis_index(axis).astype(jnp.int64)
+            n_local = jnp.clip(n_dev - s * shard_plen, 0, shard_plen)
+            alive = jnp.arange(shard_plen) < n_local
+            h = jnp.full((shard_plen,), 2166136261, dtype=jnp.uint32)
+            for ks in key_slots:
+                h = _hash_mix(h, chunk_flat[ks])
+            pids = (h & jnp.uint32(P - 1)).astype(jnp.int32)
+            hist = hist + jnp.bincount(jnp.where(alive, pids, P),
+                                       length=P + 1)[:P].astype(
+                hist.dtype).reshape(hist.shape)
+            dest = jnp.where(
+                alive,
+                ((h >> pshift) & jnp.uint32(S - 1)).astype(jnp.int32),
+                jnp.int32(S))                    # dead rows route past S
+            order = jnp.argsort(dest)
+            sd = jnp.take(dest, order)
+            first = jnp.searchsorted(sd, sd, side="left")
+            pos = jnp.arange(shard_plen) - first
+            fits = (pos < cap_ex) & (sd < S)
+            counts = jax.ops.segment_sum(
+                (sd < S).astype(jnp.int32), sd, num_segments=S + 1)[:S]
+            over = jnp.any(counts > cap_ex)
+            valid = jnp.zeros((S, cap_ex), dtype=bool).at[sd, pos].set(
+                fits, mode="drop")
+            bufs = {}
+            for i, buf in enumerate(chunk_flat):
+                if buf is None:
+                    continue
+                v = jnp.take(buf, order)
+                bufs[str(i)] = jnp.zeros(
+                    (S, cap_ex), dtype=buf.dtype).at[sd, pos].set(
+                    jnp.where(fits, v, jnp.zeros((), dtype=buf.dtype)),
+                    mode="drop")
+            pv = jnp.take(pids, order)
+            bufs["pids"] = jnp.zeros(
+                (S, cap_ex), dtype=pids.dtype).at[sd, pos].set(
+                jnp.where(fits, pv, jnp.zeros((), dtype=pids.dtype)),
+                mode="drop")
+            ex, vex = all_to_all_exchange(bufs, valid, axis)
+            out_flat = tuple(
+                ex[str(i)].reshape(-1) if b is not None else None
+                for i, b in enumerate(chunk_flat))
+            return (out_flat, vex.reshape(-1), ex["pids"].reshape(-1),
+                    hist, ovf | over.reshape(ovf.shape))
+
+        row, rep = PSpec(axis), PSpec()
+        sm = shard_map_compat(exch_body, self.mesh,
+                              (row, rep, row, row),
+                              (row, row, row, row, row))
+        return jax.jit(sm, donate_argnums=(2, 3))
+
+    def _make_reduce(self):
+        """THE one cross-shard reduce of a sharded pipeline, fused at the
+        single materializing sync: all-gather of per-shard survivor
+        counts, psum of the per-shard overflow flags and the partition
+        histogram, and a psum-OR of each outer-build bitmap (build rows
+        matched by ANY shard of ANY partition are matched) — replicated
+        outputs, so the following host fetch is one plain transfer. Its
+        collectives are counted at trace time against the static
+        budget."""
+        from jax.sharding import PartitionSpec as PSpec
+        from nds_tpu.parallel.exchange import (all_gather_counted,
+                                               psum_counted,
+                                               shard_map_compat)
+        axis = self.mesh_axis
+        build_meta = [(self.part_specs[s][1], self.part_specs[s][2])
+                      for s in self.build_slots]
+
+        def body(ns, flags, hist, *bitmaps):
+            counts = all_gather_counted(ns, axis, tiled=True)     # (S, P)
+            ovf = psum_counted(flags.astype(jnp.int32), axis)[0]  # (P,)
+            hist_tot = psum_counted(hist, axis)[0]                # (P,)
+            outs = [counts, ovf, hist_tot]
+            for (n_live, plen), bm in zip(build_meta, bitmaps):
+                matched = psum_counted(bm.astype(jnp.int32),
+                                       axis)[0] > 0               # (plen,)
+                miss = ~matched & (jnp.arange(plen) < n_live)
+                outs.append(miss)
+                outs.append(jnp.sum(miss))
+            return tuple(outs)
+
+        row, rep = PSpec(axis), PSpec()
+        sm = shard_map_compat(
+            body, self.mesh,
+            (row, row, row) + tuple(row for _ in build_meta),
+            tuple(rep for _ in range(3 + 2 * len(build_meta))))
+        return jax.jit(sm)
 
     # ---------------------------------------------------------------- run
 
@@ -536,6 +783,8 @@ class StreamPipeline:
 
     def init_acc(self):
         names, kinds, dicts, valided, dtypes, encs = self.out_template
+        if self.n_shards > 1:
+            return self._init_acc_sharded()
         datas, valids = [], []
         for j, dtype in enumerate(dtypes):
             datas.append(jnp.zeros(self.acc_cap, dtype=dtype))
@@ -545,6 +794,31 @@ class StreamPipeline:
                       for s in self.build_slots)
         return (tuple(datas), tuple(valids),
                 jnp.asarray(0, dtype=jnp.int64), jnp.asarray(False), outer)
+
+    def _init_acc_sharded(self):
+        """Sharded accumulators: every array is row-sharded over the
+        mesh, so each shard owns its ``acc_cap`` slice (datas), its count
+        and overflow slot, and its outer-build bitmap row — donated
+        through every dispatch like the single-device accumulator."""
+        from jax.sharding import NamedSharding, PartitionSpec as PSpec
+        names, kinds, dicts, valided, dtypes, encs = self.out_template
+        S = self.n_shards
+        row = NamedSharding(self.mesh, PSpec(self.mesh_axis))
+        rep = NamedSharding(self.mesh, PSpec())
+        datas, valids = [], []
+        for j, dtype in enumerate(dtypes):
+            datas.append(jax.device_put(
+                jnp.zeros(S * self.acc_cap, dtype=dtype), row))
+            valids.append(jax.device_put(
+                jnp.zeros(S * self.acc_cap, dtype=bool), row)
+                if valided[j]
+                else jax.device_put(jnp.zeros((), dtype=bool), rep))
+        outer = tuple(jax.device_put(
+            jnp.zeros((S, self.part_specs[s][2]), dtype=bool), row)
+            for s in self.build_slots)
+        return (tuple(datas), tuple(valids),
+                jax.device_put(jnp.zeros((S,), dtype=jnp.int64), row),
+                jax.device_put(jnp.zeros((S,), dtype=bool), row), outer)
 
     def _outer_miss(self, bitmaps):
         """(miss mask, device miss count) per outer-build slot: build
@@ -565,6 +839,9 @@ class StreamPipeline:
         partition counts of a partitioned run and the outer-extras
         masks/counts of deferred outer-build joins. ``chunks`` continues
         AFTER ``first_chunk`` (already converted)."""
+        if self.n_shards > 1:
+            return _run_sharded(self, chunks, first_chunk, parts_flat,
+                                resid_flat)
         if self.n_partitions > 1:
             return self._run_partitioned(chunks, first_chunk, parts_flat,
                                          resid_flat)
@@ -707,6 +984,173 @@ class StreamPipeline:
         return out, n_chunks, evidence
 
 
+def _run_sharded(pipe, chunks, first_chunk, parts_flat, resid_flat=()):
+    """Mesh-sharded drive (any partition count): every chunk uploads
+    ROW-SHARDED over the local-device mesh, dimension parts / replay
+    operands / residuals ride replicated, and the one shard_map'd
+    compiled program dispatches per partition into per-shard donated
+    accumulators. Partitioned graphs route rows first — the hash-
+    EXCHANGE pass (parallel/exchange.py all-to-alls, so each shard owns
+    a key range) or the local partition pass under
+    ``NDS_TPU_STREAM_EXCHANGE=0``. ONE cross-shard reduce at the single
+    materializing sync fetches every (shard, partition) count, overflow
+    flag, the histogram and any outer-extras — the <=6-sync budget holds
+    at any shard count, and the explicit collectives are accounted at
+    trace time against the static budget."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as PSpec
+    from nds_tpu.parallel.exchange import collective_trace
+    S, P = pipe.n_shards, pipe.n_partitions
+    row = NamedSharding(pipe.mesh, PSpec(pipe.mesh_axis))
+    rep = NamedSharding(pipe.mesh, PSpec())
+
+    def put_row(x):
+        return None if x is None else jax.device_put(x, row)
+
+    def put_rep(x):
+        return None if x is None else jax.device_put(x, rep)
+
+    parts_rep = tuple(tuple(put_rep(x) for x in p) for p in parts_flat)
+    resid_rep = tuple(tuple(put_rep(x) for x in p) for p in resid_flat)
+    ops_rep = tuple(put_rep(x) for x in pipe.operands)
+    accs = [pipe.init_acc() for _ in range(P)]
+    hist = jax.device_put(jnp.zeros((S, P), dtype=jnp.int64), row)
+    ex_ovf = jax.device_put(jnp.zeros((S,), dtype=bool), row)
+    pid_consts = [jnp.asarray(p, dtype=jnp.int32) for p in range(P)]
+
+    def first_traced(coll_attr, call):
+        """Dispatch; capture the program's trace-time collective counts
+        on its first (tracing) call."""
+        if getattr(pipe, coll_attr) is None:
+            with collective_trace() as ct:
+                out = call()
+            setattr(pipe, coll_attr, dict(ct.counts))
+            return out
+        return call()
+
+    cur = first_chunk
+    n_chunks = 0
+    h2d = 0
+    while cur is not None:
+        n_dev = jnp.asarray(E.count_int(cur.nrows), dtype=jnp.int64)
+        flat = pipe._flatten_chunk(cur)
+        h2d += sum(int(x.nbytes) for x in flat if x is not None)
+        # the sharded upload: each shard receives its row slice
+        flat = tuple(put_row(x) for x in flat)
+        pids = live = None
+        if pipe.exchange:
+            with _obs.span("stream.exchange", chunk=n_chunks, shards=S,
+                           partitions=P):
+                flat, live, pids, hist, ex_ovf = first_traced(
+                    "coll_exchange",
+                    lambda f=flat, h=hist, o=ex_ovf:
+                    pipe._exch_jit(f, n_dev, h, o))
+        elif P > 1:
+            with _obs.span("stream.partition", chunk=n_chunks,
+                           partitions=P, shards=S):
+                pids, hist = pipe._pid_jit(flat, n_dev, hist)
+        for p in range(P):
+            phase = "stream.drive" if pipe.traced_once else "stream.compile"
+            args = (flat, n_dev, parts_rep, ops_rep, accs[p], resid_rep,
+                    pids, pid_consts[p] if P > 1 else None, live)
+            with _obs.span(phase, chunk=n_chunks, part=p):
+                accs[p] = first_traced("coll_chunk",
+                                       lambda a=args: pipe.jitted(*a))
+            pipe.traced_once = True
+        n_chunks += 1
+        with _obs.span("stream.prefetch", chunk=n_chunks):
+            cur = next(chunks, None)
+
+    # one cross-shard reduce, one materializing transfer
+    ns = jnp.stack([a[2] for a in accs], axis=1)          # (S, P)
+    flags = jnp.stack([a[3] for a in accs], axis=1)       # (S, P)
+    flags = flags | ex_ovf[:, None]
+    bitmaps = []
+    for j in range(len(pipe.build_slots)):
+        bm = accs[0][4][j]
+        for p in range(1, P):
+            bm = bm | accs[p][4][j]
+        bitmaps.append(bm)
+
+    with _obs.span("stream.materialize", chunks=n_chunks, shards=S,
+                   partitions=P):
+        outs = first_traced("coll_reduce",
+                            lambda: pipe._reduce_jit(ns, flags, hist,
+                                                     *bitmaps))
+        got = E.timed_read("stream_final",
+                           lambda: jax.device_get(list(outs)))
+    counts = np.asarray(got[0], dtype=np.int64)           # (S, P)
+    ovf_host = [int(x) for x in np.asarray(got[1]).ravel()]
+    hist_host = [int(x) for x in np.asarray(got[2]).ravel()]
+    extras_pairs = list(zip(outs[3::2], [int(x) for x in got[4::2]]))
+
+    def ops_of(c):
+        return (c["a2a"] + c["psum"] + c["all_gather"]) if c else 0
+
+    def bytes_of(c):
+        return c["bytes"] if c else 0
+
+    dispatches = n_chunks * P
+    collectives = (ops_of(pipe.coll_chunk) * dispatches
+                   + ops_of(pipe.coll_exchange) * n_chunks
+                   + ops_of(pipe.coll_reduce))
+    bytes_ici = (bytes_of(pipe.coll_chunk) * dispatches
+                 + bytes_of(pipe.coll_exchange) * n_chunks
+                 + bytes_of(pipe.coll_reduce))
+    evidence = {"h2d": h2d, "shards": S,
+                "shard_rows": tuple(int(x) for x in counts.sum(axis=1)),
+                "collectives": collectives, "bytes_ici": bytes_ici,
+                "outer": [(slot, m, n) for (slot, (m, n)) in
+                          zip(pipe.build_slots, extras_pairs)]}
+    if P > 1:
+        evidence["partitions"] = P
+        evidence["part_rows"] = tuple(int(x) for x in counts.sum(axis=0))
+        evidence["part_input"] = tuple(hist_host)
+    if any(ovf_host):
+        return None, n_chunks, evidence
+    tables = [_slice_acc_sharded(pipe, accs[p][0], accs[p][1],
+                                 counts[:, p])
+              for p in range(P) if counts[:, p].sum() > 0]
+    if not tables:                       # every shard of every partition
+        out = _slice_acc_sharded(pipe, accs[0][0], accs[0][1],
+                                 np.zeros(S, dtype=np.int64))
+    elif len(tables) == 1:
+        out = tables[0]
+    else:
+        # counts are host-known here, so the union costs no sync
+        out = E.concat_tables(tables)
+    return out, n_chunks, evidence
+
+
+def _slice_acc_sharded(pipe, datas, valids, shard_counts):
+    """Survivor rows of one sharded accumulator as a DeviceTable: shard
+    ``s``'s survivors live at ``[s*acc_cap, s*acc_cap + count_s)`` of the
+    row-sharded arrays — counts are host-known after the materializing
+    transfer, so the gather index builds on host and the device gather
+    costs no sync. Pad rows zero out, matching the zero-initialized
+    accumulator padding of the single-device path."""
+    import numpy as np
+    names, kinds, dicts, valided, dtypes, encs = pipe.out_template
+    counts = [int(c) for c in shard_counts]
+    total = sum(counts)
+    cap = E.bucket_len(total)
+    idx_host = np.concatenate(
+        [np.arange(c, dtype=np.int64) + s * pipe.acc_cap
+         for s, c in enumerate(counts)] + [np.zeros(0, np.int64)])
+    idx = jnp.asarray(np.concatenate(
+        [idx_host, np.zeros(cap - total, np.int64)]))
+    live = jnp.arange(cap) < total
+    cols = {}
+    for j, n in enumerate(names):
+        d = jnp.take(datas[j], idx, mode="clip")
+        d = jnp.where(live, d, jnp.zeros((), dtype=d.dtype))
+        v = None
+        if valided[j]:
+            v = jnp.take(valids[j], idx, mode="clip") & live
+        cols[n] = Column(kinds[j], d, v, dicts[j], encs[j])
+    return DeviceTable(cols, total, plen=cap)
+
+
 def _weak(x):
     """weakref.ref when the buffer supports it; a strong closure otherwise
     (plain ndarrays aren't weakref-able) — callers just call the ref."""
@@ -726,6 +1170,7 @@ def _dicts_equal(a, b) -> bool:
 def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
                part_infos, chunk_spec, chunk_cap, stream_rows, outer_meta):
     from nds_tpu.analysis.mem_audit import (stream_partitions_env,
+                                            stream_shards_env,
                                             stream_skew_factor)
     from nds_tpu.engine.column import enc_key
     from nds_tpu.sql.parser import expr_key
@@ -748,6 +1193,10 @@ def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
         # partition count
         _acc_ceiling(), _hbm_bytes(), E.stream_fanout(),
         stream_partitions_env(), stream_skew_factor(), int(stream_rows),
+        # sharded-execution knobs: a pipeline compiled for one mesh shape
+        # (or exchange mode) must never serve another
+        stream_shards_env(), os.environ.get("NDS_TPU_STREAM_EXCHANGE"),
+        os.environ.get("NDS_TPU_STREAM_MESH_AXIS"),
     )
 
 
@@ -962,9 +1411,16 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
                         rows=survivor_total,
                         partitions=evidence.get("partitions", 1),
                         part_rows=evidence.get("part_rows", ()),
-                        bytes_h2d=h2d)
+                        bytes_h2d=h2d,
+                        shards=evidence.get("shards", 1),
+                        collectives=evidence.get("collectives", -1),
+                        bytes_ici=evidence.get("bytes_ici", -1),
+                        shard_rows=evidence.get("shard_rows", ()))
     _obs.annotate(path="compiled", chunks=ran,
                   partitions=evidence.get("partitions", 1),
+                  shards=evidence.get("shards", 1),
+                  collectives=evidence.get("collectives", -1),
+                  bytesIci=evidence.get("bytes_ici", -1),
                   bytesH2d=h2d,
                   bytesLogical=_logical_chunk_bytes(pipe.chunk_spec,
                                                     pipe.chunk_cap, ran))
@@ -1087,8 +1543,27 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
     else:
         budget = _acc_row_budget(n_chunks, out0.plen, proved,
                                  max(row_bytes, 1))
+    # mesh-sharded execution: each shard accumulates its own slice, so
+    # the budget re-shares over the mesh (skew-factored like the
+    # partition share — mem_audit.shard_row_bound, the lockstep rule);
+    # the recorded out bucket stays the floor, so a per-shard dispatch
+    # can always land one full chunk output
+    n_shards, mesh, axis_name = _shard_plan(chunk_cap)
+    exchange, cap_ex = False, 0
+    if n_shards > 1:
+        from nds_tpu.analysis.mem_audit import stream_skew_factor
+        budget = min(budget, -(-budget // n_shards) * stream_skew_factor())
+        if n_parts > 1 and key_slots and \
+                os.environ.get("NDS_TPU_STREAM_EXCHANGE", "1") != "0":
+            # the partitioned graph's keys are not co-partitioned with
+            # the arbitrary row split: hash-exchange rows over ICI so
+            # each shard owns a key range
+            exchange = True
+            cap_ex = E.bucket_len(
+                max((chunk_cap // n_shards) // n_shards, 1)
+                * stream_skew_factor())
     acc_cap = E.bucket_len(max(budget, out0.plen))
-    _obs.annotate(accRows=acc_cap, partitions=n_parts,
+    _obs.annotate(accRows=acc_cap, partitions=n_parts, shards=n_shards,
                   provedRows=proved if proved is not None else "unproven",
                   residuals=len(residuals), outerBuilds=len(build_slots))
     lifted, operands = _lift_log(list(rec_log))
@@ -1100,6 +1575,8 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
         n_partitions=n_parts, key_slots=key_slots,
         outer_meta=outer_meta, residuals=residuals,
         resid_specs=tuple(spec for (spec, _flat) in resid_infos),
-        build_slots=build_slots, name_catalog=name_cat)
+        build_slots=build_slots, name_catalog=name_cat,
+        n_shards=n_shards, mesh=mesh, mesh_axis=axis_name or "shard",
+        exchange=exchange, cap_ex=cap_ex)
     return (pipe.compile(join_preds, where_conjuncts, masked_sources),
             resid_infos)

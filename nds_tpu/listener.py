@@ -126,6 +126,20 @@ class StreamEvent:
     #                            NARROW representation — compression wins
     #                            are measured here, not asserted; -1 =
     #                            unknown)
+    shards: int = 1            # mesh shard count of the compiled pipeline
+    #                            (NDS_TPU_STREAM_SHARDS; 1 = single-device)
+    collectives: int = -1      # explicit ICI collective ops the sharded
+    #                            pipeline issued (exchange all-to-alls x
+    #                            chunks + the one cross-shard materialize
+    #                            reduce) — the evidence exec_audit's
+    #                            static collective budget is checked
+    #                            against; -1 = unknown/unsharded
+    bytes_ici: int = -1        # wire bytes those collectives moved
+    #                            (encoded codes ride the exchange, so
+    #                            compression shrinks this too)
+    shard_rows: tuple = ()     # per-shard survivor counts (shard order,
+    #                            summed over partitions) — checked against
+    #                            mem_audit's per-shard bound
 
 
 _stream_tls = threading.local()
@@ -134,7 +148,9 @@ _stream_tls = threading.local()
 def record_stream_event(where: str, chunks: int, syncs: int, path: str,
                         reason: str = "", rows: int = -1,
                         partitions: int = 1, part_rows=(),
-                        bytes_h2d: int = -1) -> None:
+                        bytes_h2d: int = -1, shards: int = 1,
+                        collectives: int = -1, bytes_ici: int = -1,
+                        shard_rows=()) -> None:
     """Engine-side hook (engine/stream.py, sql/planner.py): record how a
     streamed scan executed. Thread-scoped like the sync counters, so
     concurrent Throughput streams account their own pipelines."""
@@ -143,7 +159,9 @@ def record_stream_event(where: str, chunks: int, syncs: int, path: str,
         # deque(maxlen): diagnostics ring, never unbounded, O(1) evict
         lst = _stream_tls.events = deque(maxlen=1000)
     lst.append(StreamEvent(where, chunks, syncs, path, reason, rows,
-                           partitions, tuple(part_rows), bytes_h2d))
+                           partitions, tuple(part_rows), bytes_h2d,
+                           shards, collectives, bytes_ici,
+                           tuple(shard_rows)))
 
 
 def drain_stream_events() -> list:
@@ -169,6 +187,9 @@ def stream_event_json(e: StreamEvent) -> dict:
         **({"bytesH2d": e.bytes_h2d} if e.bytes_h2d >= 0 else {}),
         **({"partitions": e.partitions, "partRows": list(e.part_rows)}
            if e.partitions > 1 else {}),
+        **({"shards": e.shards, "shardRows": list(e.shard_rows),
+            "collectives": e.collectives, "bytesIci": e.bytes_ici}
+           if e.shards > 1 else {}),
         **({"reason": e.reason} if e.reason else {}),
     }
 

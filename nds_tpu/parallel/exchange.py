@@ -18,10 +18,87 @@ static-shape analog of a shuffle spill.
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# ---------------------------------------------------------------------------
+# collective accounting: the runtime half of the static collective budget
+# (analysis/exec_audit.py). Every explicit ICI collective this module (or
+# the sharded streamed pipeline, engine/stream.py) issues notes itself at
+# TRACE time — the note runs once per compiled program, so a program's
+# collective count is captured when its first dispatch traces and is then
+# exact for every later dispatch. tools/exec_audit_diff.py checks the
+# resulting ``StreamEvent.collectives``/``bytes_ici`` evidence against the
+# audit's per-statement budget. GSPMD-inserted data-placement copies
+# (replicated operand broadcast) are not collectives of the pipeline's
+# programs and are out of scope by definition.
+# ---------------------------------------------------------------------------
+
+_coll_tls = threading.local()
+
+
+class _CollectiveTrace:
+    def __enter__(self):
+        self._prev = getattr(_coll_tls, "counts", None)
+        self.counts = {"a2a": 0, "psum": 0, "all_gather": 0, "bytes": 0}
+        _coll_tls.counts = self.counts
+        return self
+
+    def __exit__(self, *exc):
+        _coll_tls.counts = self._prev
+
+
+def collective_trace():
+    """Context collecting (at trace time) the explicit collective ops and
+    their wire bytes issued while tracing one jitted program."""
+    return _CollectiveTrace()
+
+
+def _note_collective(kind: str, n: int = 1, nbytes: int = 0) -> None:
+    c = getattr(_coll_tls, "counts", None)
+    if c is not None:
+        c[kind] += n
+        c["bytes"] += int(nbytes)
+
+
+def _aval_bytes(x) -> int:
+    """Static byte size of a (traced or concrete) array — the wire bytes
+    one collective moves, readable at trace time from shape metadata."""
+    try:
+        return int(np.prod(x.shape)) * x.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def psum_counted(x, axis: str):
+    """``jax.lax.psum`` with collective accounting (use inside shard_map
+    bodies the streamed pipeline compiles)."""
+    _note_collective("psum", 1, _aval_bytes(x))
+    return jax.lax.psum(x, axis)
+
+
+def all_gather_counted(x, axis: str, tiled: bool = True):
+    """``jax.lax.all_gather`` with collective accounting."""
+    _note_collective("all_gather", 1, _aval_bytes(x))
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """``shard_map`` across jax versions (the replication-check kwarg was
+    renamed when it moved out of experimental); checks disabled — the
+    engine's bodies are manual SPMD by design."""
+    try:
+        from jax import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "part") -> Mesh:
@@ -81,6 +158,9 @@ def all_to_all_exchange(bufs: dict, valid: jnp.ndarray, axis: str = "part"):
     ``(P, capacity)`` rows — one bucket from every peer — all sharing its key
     range.
     """
+    _note_collective("a2a", len(bufs) + 1,
+                     sum(_aval_bytes(b) for b in bufs.values())
+                     + _aval_bytes(valid))
     out = {name: jax.lax.all_to_all(buf, axis, split_axis=0, concat_axis=0)
            for name, buf in bufs.items()}
     vout = jax.lax.all_to_all(valid, axis, split_axis=0, concat_axis=0)
@@ -128,23 +208,45 @@ def sharded_filter_agg_step(mesh: Mesh, num_groups: int, capacity: int,
         total = jax.lax.psum(jnp.sum(w_live), axis)
         return sums, counts, total
 
-    try:
-        from jax import shard_map
-        rep_kw = {"check_vma": False}
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
-        rep_kw = {"check_rep": False}
-
-    sharded = shard_map(
-        local_step, mesh=mesh,
+    sharded = shard_map_compat(
+        local_step, mesh,
         in_specs=(P(axis), P(axis), P(axis), P(), P()),
-        out_specs=(P(axis), P(), P()),
-        **rep_kw)
+        out_specs=(P(axis), P(), P()))
     in_shardings = (
         NamedSharding(mesh, P(axis)), NamedSharding(mesh, P(axis)),
         NamedSharding(mesh, P(axis)), NamedSharding(mesh, P()),
         NamedSharding(mesh, P()))
     return jax.jit(sharded, in_shardings=in_shardings)
+
+
+def stream_mesh_axis() -> str:
+    """``NDS_TPU_STREAM_MESH_AXIS``: name of the streamed pipeline's mesh
+    axis (default ``shard``; must differ from the session mesh's ``part``
+    axis when both are active)."""
+    import os
+    return os.environ.get("NDS_TPU_STREAM_MESH_AXIS", "shard")
+
+
+_STREAM_MESHES: dict = {}
+
+
+def stream_mesh(n_shards: int, axis: str | None = None) -> Mesh | None:
+    """LOCAL-device 1-D mesh the sharded streamed pipeline runs over, or
+    None when this process has fewer than ``n_shards`` local devices
+    (the pipeline then builds unsharded). Local by design: chunk sharding
+    is an ICI-level optimization of one host's scan; cross-host (DCN)
+    distribution stays the loader's ``host_shard_range`` split, so a
+    federated Power Run shards its local chunk pipelines under the
+    multi-controller runtime without any cross-host collective."""
+    axis = axis or stream_mesh_axis()
+    key = (int(n_shards), axis)
+    m = _STREAM_MESHES.get(key)
+    if m is None:
+        devs = jax.local_devices()
+        if len(devs) < n_shards:
+            return None
+        m = _STREAM_MESHES[key] = Mesh(np.asarray(devs[:n_shards]), (axis,))
+    return m
 
 
 def mesh_of(*arrays):
@@ -241,18 +343,10 @@ def _exchange_join_step(mesh, cap_in: int, pair_cap: int, axis: str):
                        p_over.astype(jnp.int64)]), axis)
         return l_out, r_out, pair_live, overs
 
-    try:
-        from jax import shard_map
-        rep_kw = {"check_vma": False}
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
-        rep_kw = {"check_rep": False}
-
-    sharded = shard_map(
-        local, mesh=mesh,
+    sharded = shard_map_compat(
+        local, mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(axis), P(axis), P(axis), P()),
-        **rep_kw)
+        out_specs=(P(axis), P(axis), P(axis), P()))
     return jax.jit(sharded)
 
 

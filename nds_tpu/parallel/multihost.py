@@ -17,6 +17,17 @@ Environment contract (exported by the launch templates, base.template):
     NDS_COORDINATOR=host:port   coordinator (omit on TPU pods: auto-detect)
     NDS_NUM_PROCESSES=N         process count (omit on TPU pods)
     NDS_PROCESS_ID=i            this process's id (omit on TPU pods)
+    JAX_CPU_COLLECTIVES_IMPLEMENTATION=gloo
+                                cross-process collectives on the CPU
+                                backend (the DCN stand-in CI federates
+                                with). jax does NOT read this env var
+                                into its config flag, so initialization
+                                applies it via ``jax.config.update``
+                                before the backend client exists —
+                                without it every cross-process
+                                computation fails with "Multiprocess
+                                computations aren't implemented on the
+                                CPU backend".
 
 On Cloud TPU pods all three specifics auto-detect from the metadata
 server, so ``NDS_TPU_MULTIHOST=1`` alone is sufficient there.
@@ -49,6 +60,15 @@ def maybe_initialize() -> bool:
     if not os.environ.get("NDS_TPU_MULTIHOST"):
         return False
     import jax
+    impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION")
+    if impl:
+        # the env spelling is NOT auto-read by jax's flag machinery: wire
+        # it through the config before the CPU client is created, or the
+        # federated mesh cannot run a single cross-process computation
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", impl)
+        except Exception:  # pragma: no cover - flagless jax build
+            pass
     kwargs = {}
     if os.environ.get("NDS_COORDINATOR"):
         kwargs["coordinator_address"] = os.environ["NDS_COORDINATOR"]

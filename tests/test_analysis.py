@@ -345,6 +345,52 @@ def test_jax_lint_span_in_jit(tmp_path):
     assert [f.rule for f in fs] == ["span-in-jit"]
 
 
+def test_jax_lint_host_sync_in_shard_map(tmp_path):
+    """Both directions of the host-sync-in-shard-map rule: host reads,
+    engine sync entry points, a one-level-down syncing helper and an
+    obs.span inside a shard_map/pjit body are errors; the same calls
+    outside any shard body (or a clean body) are not."""
+    fs = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental.shard_map import shard_map
+        from nds_tpu.engine import ops
+        from nds_tpu.obs import trace as obs
+
+        def _helper(x):
+            return ops.count_int(x.nrows)
+
+        def make(mesh, specs):
+            def local(x, n):
+                with obs.span("inner"):
+                    pass
+                ops.host_read("tag", lambda: 1)
+                n.to_int()
+                _helper(x)
+                return x
+            return shard_map(local, mesh=mesh, in_specs=specs,
+                             out_specs=specs)
+    """, rel="nds_tpu/parallel/other.py")
+    rules = [f.rule for f in fs]
+    assert rules == ["host-sync-in-shard-map"] * 4, fs
+    assert all(f.severity == "error" for f in fs)
+    # clean body + syncs OUTSIDE the body: no findings (the rule must
+    # not leak past the shard_map'd function)
+    fs = lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        from nds_tpu.engine import ops
+        from nds_tpu.parallel.exchange import shard_map_compat
+
+        def make(mesh, specs):
+            def local(x):
+                return jax.lax.psum(x, "shard")
+            step = shard_map_compat(local, mesh, specs, specs)
+            n = ops.count_int(4)          # outside: legal
+            return step, n
+    """, rel="nds_tpu/parallel/other.py")
+    assert not [f for f in fs if f.rule == "host-sync-in-shard-map"], fs
+
+
 def test_jax_lint_span_outside_jit_ok(tmp_path):
     # the supported shape: open the span AROUND the jitted call
     fs = lint_snippet(tmp_path, """
@@ -890,6 +936,54 @@ def test_exec_audit_corpus_full_coverage():
     assert [r.to_dict() for r in again] == [r.to_dict() for r in reports]
 
 
+def test_exec_audit_collective_budget_and_gate():
+    """Sharded collective budget: under a forced mesh env the model
+    prices the exchange pass from the scan's pruned width and keys, the
+    corpus stays within the collective-budget gate, and a hand-built
+    over-budget verdict trips the gate (a gate that cannot fail proves
+    nothing). Without the env, every budget is zero — the corpus
+    classification cannot move."""
+    from nds_tpu.analysis.exec_audit import (COLLECTIVE_CHUNK_BUDGET,
+                                             COLLECTIVE_FINAL_BUDGET,
+                                             ExecReport, ScanVerdict,
+                                             reports_to_findings)
+    # unsharded default: zero budgets
+    r = exec_audit("""
+        select ss_item_sk, count(*) c from store_sales, store_returns
+        where ss_item_sk = sr_item_sk group by ss_item_sk""")
+    assert r.scans[0].shards == 1 and r.scans[0].a2a_chunk == 0
+    old = os.environ.get("NDS_TPU_STREAM_SHARDS")
+    os.environ["NDS_TPU_STREAM_SHARDS"] = "2"
+    try:
+        r = exec_audit("""
+            select ss_item_sk, count(*) c from store_sales, store_returns
+            where ss_item_sk = sr_item_sk group by ss_item_sk""")
+        s = r.scans[0]
+        assert s.shards == 2
+        # keys present: the exchange MAY run — bounded by 2 x width + 2
+        assert 0 < s.a2a_chunk <= COLLECTIVE_CHUNK_BUDGET
+        assert s.coll_final == 3
+        assert not reports_to_findings([r])
+        # a keyless scan can never exchange: per-chunk budget zero
+        r2 = exec_audit("select ss_item_sk, count(*) c from store_sales "
+                        "group by ss_item_sk")
+        assert r2.scans[0].a2a_chunk == 0 and r2.scans[0].coll_final == 3
+    finally:
+        if old is None:
+            del os.environ["NDS_TPU_STREAM_SHARDS"]
+        else:
+            os.environ["NDS_TPU_STREAM_SHARDS"] = old
+    # the gate can fail: an over-budget verdict is an error finding
+    bad = ExecReport(
+        "toy.tpl", "toy", "compiled-stream",
+        scans=(ScanVerdict("ss", "store_sales", True, shards=2,
+                           a2a_chunk=COLLECTIVE_CHUNK_BUDGET + 1,
+                           coll_final=COLLECTIVE_FINAL_BUDGET + 1),))
+    fs = reports_to_findings([bad])
+    assert [f.rule for f in fs] == ["collective-budget"]
+    assert fs[0].severity == "error"
+
+
 def test_exec_audit_differential_harness():
     """The lockstep contract: static path/sync predictions must match the
     runtime StreamEvent evidence on the A/B templates, and the harness
@@ -909,6 +1003,34 @@ def test_exec_audit_differential_harness():
                                         inject_drift=True)
     assert not drift_ok, "drift fixture failed to fail"
     assert any("MISMATCH" in ln for ln in drift_lines)
+
+
+def test_exec_audit_sharded_collective_differential():
+    """The sharded half of the lockstep contract: the measured
+    ``StreamEvent.collectives`` of the shard_map'd pipeline (forced
+    2-shard mesh) must fit the static budget ``a2a_chunk x chunks +
+    coll_final`` on the sharded A/B subset, the exchange pass must
+    charge zero host syncs, and the zeroed-budget drift fixture must
+    fail — the partitioned template really crosses shards, so a zero
+    budget cannot hold."""
+    import importlib.util
+    path = os.path.join(REPO, "tools", "exec_audit_diff.py")
+    spec = importlib.util.spec_from_file_location("exec_audit_diff2", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    shard_ev, n_shards = mod.collect_sharded_evidence()
+    assert shard_ev, "sharded sweep found no multi-device mesh"
+    ab = mod._load_ab_module()
+    with ab._forced_stream_partitions():
+        with ab._forced_stream_shards():
+            reports = mod.predict(ab._STREAM_AB_QUERIES)
+    ok, lines = mod.compare_sharded(reports, shard_ev, n_shards)
+    assert ok, "\n".join(lines)
+    drift_ok, drift_lines = mod.compare_sharded(reports, shard_ev,
+                                                n_shards,
+                                                inject_drift=True)
+    assert not drift_ok, "sharded drift fixture failed to fail"
+    assert any("collectives > static budget" in ln for ln in drift_lines)
 
 
 # ---------------------------------------------------------------------------
@@ -1159,6 +1281,32 @@ def test_mem_audit_differential_harness():
     drift_ok, drift_lines = mod.compare(reports, evidence,
                                         inject_drift=True)
     assert not drift_ok, "drift fixture failed to fail"
+    assert any("UNSOUND" in ln for ln in drift_lines)
+
+
+def test_mem_audit_sharded_bound_differential():
+    """The sharded half of the soundness contract: every per-shard
+    survivor count (``StreamEvent.shard_rows``) of the shard_map'd
+    pipeline must fit the proven per-shard bound
+    (``mem_audit.shard_row_bound`` — rows/shards x skew through the
+    fan-out), the runtime shard count must equal the model's, and the
+    zeroed-bound drift fixture must fail."""
+    path = os.path.join(REPO, "tools", "mem_audit_diff.py")
+    spec = importlib.util.spec_from_file_location("mem_audit_diff2", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    shard_ev, bounds, n_shards = mod.collect_sharded_evidence()
+    assert shard_ev, "sharded sweep found no multi-device mesh"
+    ab = mod._load_ab_module()
+    with ab._forced_stream_partitions():
+        with ab._forced_stream_shards():
+            reports = mod.predict(ab._STREAM_AB_QUERIES, bounds)
+    ok, lines = mod.compare_sharded(reports, shard_ev, n_shards)
+    assert ok, "\n".join(lines)
+    drift_ok, drift_lines = mod.compare_sharded(reports, shard_ev,
+                                                n_shards,
+                                                inject_drift=True)
+    assert not drift_ok, "sharded drift fixture failed to fail"
     assert any("UNSOUND" in ln for ln in drift_lines)
 
 

@@ -4,10 +4,12 @@
 Two layers: plumbing units (env parsing, idempotence, host-shard
 arithmetic) and a REAL 2-process ``jax.distributed`` federation on
 localhost — each process contributes 4 virtual CPU devices, the global
-8-device mesh spans both, and a row-sharded aggregation query plus the
-exchange join run with gloo collectives actually crossing the process
-boundary (the DCN stand-in; SURVEY.md §5.8). The reference's analog only
-ever runs on a real cluster (GenTable.java:120-141) — this executes in CI.
+8-device mesh spans both, and a row-sharded aggregation query, the
+exchange join, and a SHARDED STREAMED template (the compiled chunk
+pipeline over each host's local mesh, engine/stream.py) run with gloo
+collectives actually crossing the process boundary (the DCN stand-in;
+SURVEY.md §5.8). The reference's analog only ever runs on a real
+cluster (GenTable.java:120-141) — this executes in CI.
 """
 
 import json
@@ -82,6 +84,24 @@ def test_two_process_federation_runs_real_query():
     from tools.multihost_worker import exchange_keys
     assert payload["pairs"] == sum(
         int(c) ** 2 for c in np.bincount(exchange_keys()))
+
+    # streamed-arm ground truth: the same chunked template, single
+    # process — the federated run must have taken the compiled pipeline
+    # SHARDED over its local mesh and produced bit-identical rows
+    from tools.multihost_worker import (STREAM_CHUNK_ROWS, STREAM_SHARDS,
+                                        STREAM_SQL, make_stream_tables)
+    from nds_tpu.engine.table import ChunkedTable
+    s3 = Session()
+    s3.create_temp_view(
+        "f", ChunkedTable(make_stream_tables(),
+                          chunk_rows=STREAM_CHUNK_ROWS), base=True)
+    expect_stream = [list(r) for r in s3.sql(STREAM_SQL).collect()]
+    assert payload["streamRows"] == expect_stream
+    ev = payload["streamEvent"]
+    assert ev is not None, "federated worker recorded no stream event"
+    assert ev["path"] == "compiled", ev
+    assert ev["shards"] == STREAM_SHARDS, ev
+    assert ev["collectives"] >= 0, ev
 
 
 @pytest.fixture(autouse=True)

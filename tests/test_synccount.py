@@ -352,6 +352,42 @@ _STREAM_AB_PARTITIONED = tuple(
 # session's bounds all fit 16 GiB, so auto mode would never partition)
 _STREAM_AB_PARTITION_COUNT = 2
 
+# indexes of the templates the SHARDED A/B sweep drives over a forced
+# 2-shard device mesh (NDS_TPU_STREAM_SHARDS, conftest's virtual
+# 8-device CPU mesh): the flagship star join, the psum'd grouped
+# aggregate, and one fan-out partitioned join — the template whose
+# per-chunk hash-EXCHANGE pass crosses shards through the
+# parallel/exchange.py all-to-alls. Shared with both differential
+# harnesses (tools/exec_audit_diff.py, tools/mem_audit_diff.py), which
+# verify the static collective budget and per-shard memory bound
+# against the StreamEvent evidence these runs produce.
+_STREAM_AB_SHARDED = (0, 2, 7)
+
+# the shard count every sharded A/B sweep forces
+_STREAM_AB_SHARD_COUNT = 2
+
+
+@contextlib.contextmanager
+def _forced_stream_shards(n=_STREAM_AB_SHARD_COUNT):
+    """Pin NDS_TPU_STREAM_SHARDS — and STRICT stream failures — for one
+    sharded A/B sweep: the ONE save/set/restore shared by
+    test_sharded_compiled_matches_single_device_eager and both
+    differential harnesses, so the forced mesh shape can never drift
+    between the fixtures and their checkers."""
+    import os
+    old = {k: os.environ.get(k) for k in ("NDS_TPU_STREAM_SHARDS",
+                                          "NDS_TPU_STREAM_STRICT")}
+    os.environ["NDS_TPU_STREAM_SHARDS"] = str(n)
+    os.environ["NDS_TPU_STREAM_STRICT"] = "1"
+    try:
+        yield n
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
 
 @contextlib.contextmanager
 def _forced_stream_partitions(n=_STREAM_AB_PARTITION_COUNT):
@@ -458,6 +494,69 @@ def test_streamed_compiled_matches_eager():
     for (q, _), a, b in zip(_STREAM_AB_QUERIES, compiled_rows, eager_rows):
         assert a == b, f"compiled/eager divergence on: {q}"
         assert a, f"A/B template unexpectedly empty: {q}"
+
+
+def test_sharded_compiled_matches_single_device_eager():
+    """A/B correctness of SHARDED streamed execution: the sharded subset
+    (star join, psum'd grouped aggregate, fan-out partitioned join) must
+    produce bit-identical rows through the shard_map'd compiled pipeline
+    over a forced 2-shard mesh and through the single-device eager loop.
+    Every event must report the forced shard count, per-shard survivor
+    counts summing to the scan total, non-negative collective/ICI-byte
+    evidence, and the <=6-host-sync budget must hold unchanged — the one
+    cross-shard reduce rides the single materializing transfer. The
+    partitioned template must drive the hash-EXCHANGE pass: its
+    collective count covers at least one all-to-all per chunk."""
+    import os
+
+    import jax
+
+    from nds_tpu.listener import drain_stream_events
+    if len(jax.local_devices()) < _STREAM_AB_SHARD_COUNT:
+        pytest.skip("needs a multi-device (virtual) mesh")
+    compiled_rows = {}
+    with _forced_stream_partitions():
+        with _forced_stream_shards() as n_shards:
+            s = _chunked_star_session(np.random.default_rng(42))
+            drain_stream_events()
+            for i in _STREAM_AB_SHARDED:
+                q, _must = _STREAM_AB_QUERIES[i]
+                before = _syncs()
+                compiled_rows[i] = s.sql(q).collect()
+                used = _syncs() - before
+                events = drain_stream_events()
+                assert events and all(e.path == "compiled"
+                                      for e in events), \
+                    f"sharded arm fell back on: {q}"
+                assert used <= 6, \
+                    f"sharded template used {used} syncs (budget 6): {q}"
+                for e in events:
+                    assert e.shards == n_shards, (q, e)
+                    assert len(e.shard_rows) == n_shards
+                    assert sum(e.shard_rows) == e.rows
+                    assert e.collectives >= 0 and e.bytes_ici >= 0
+                if i in _STREAM_AB_PARTITIONED:
+                    (e,) = events
+                    assert e.partitions == _STREAM_AB_PARTITION_COUNT
+                    assert sum(e.part_rows) == e.rows
+                    # the exchange pass's all-to-alls ran every chunk
+                    assert e.collectives >= e.chunks, (q, e)
+    old = os.environ.get("NDS_TPU_STREAM_EXEC")
+    os.environ["NDS_TPU_STREAM_EXEC"] = "eager"
+    try:
+        s2 = _chunked_star_session(np.random.default_rng(42))
+        for i in _STREAM_AB_SHARDED:
+            q, _ = _STREAM_AB_QUERIES[i]
+            eager = s2.sql(q).collect()
+            assert eager == compiled_rows[i], \
+                f"sharded-compiled/eager divergence on: {q}"
+            assert eager, f"sharded A/B template unexpectedly empty: {q}"
+    finally:
+        if old is None:
+            del os.environ["NDS_TPU_STREAM_EXEC"]
+        else:
+            os.environ["NDS_TPU_STREAM_EXEC"] = old
+    drain_stream_events()
 
 
 def test_hybrid_auto_delivers_sync_ceiling(star_session, monkeypatch):

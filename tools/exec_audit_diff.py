@@ -31,11 +31,26 @@ disagrees with what actually ran:
   is device-only by construction), and the sync/budget checks above hold
   unchanged — the partition pass is sync-free, so no bound moves.
 
-``--inject-drift`` flips every predicted path before comparing — a model-
-drift fixture that MUST fail, proving the harness can catch a stale model
-(``tests/test_analysis.py`` asserts both directions). Run it after any
-change to ``Planner._stream_join_parts``, ``engine/stream.py`` routing, or
-the sync behavior of ``engine/ops.py``: the static model and the executor
+* **collective budget** — a SECOND mini-sweep drives the sharded subset
+  (``_STREAM_AB_SHARDED``: star join, psum'd grouped aggregate, fan-out
+  partitioned join) through the shard_map'd pipeline under a forced
+  2-shard mesh (``NDS_TPU_STREAM_SHARDS``, the shared
+  ``_forced_stream_shards`` context; the harness forces a multi-device
+  virtual CPU mesh via XLA_FLAGS below). Every event must report the
+  forced shard count, its measured ``StreamEvent.collectives`` (the
+  trace-time explicit-collective accounting of
+  ``parallel.exchange.collective_trace``) must fit the static budget
+  ``a2a_chunk x chunks + coll_final``, and the exchange/partition spans
+  must charge ZERO host syncs. The partitioned template must actually
+  exchange (nonzero collectives), so ``--inject-drift`` — which zeroes
+  the static collective budget on this sweep — must fail.
+
+``--inject-drift`` flips every predicted path (and zeroes the collective
+budget) before comparing — a model-drift fixture that MUST fail, proving
+the harness can catch a stale model (``tests/test_analysis.py`` asserts
+both directions). Run it after any change to
+``Planner._stream_join_parts``, ``engine/stream.py`` routing, or the
+sync behavior of ``engine/ops.py``: the static model and the executor
 are kept in lockstep the same way ``plan_audit`` tracks
 ``Planner._resolve_name``.
 """
@@ -49,6 +64,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the sharded sweep needs a multi-device mesh: force the virtual CPU
+# devices BEFORE jax initializes (no-op when the caller already did —
+# tests/conftest.py forces 8)
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
 
 
 def _load_ab_module():
@@ -130,6 +153,116 @@ def predict(queries):
     auditor = ExecAuditor(streamed={"store_sales"})
     return [auditor.audit_sql(sql, query=f"ab{i + 1}")
             for i, (sql, _must) in enumerate(queries)]
+
+
+def collect_sharded_evidence():
+    """Drive the sharded subset through the shard_map'd pipeline (forced
+    shard count + forced partitions, both via the fixture module's shared
+    contexts) and return (per-template evidence, forced shard count).
+    Empty evidence when this process lacks a multi-device mesh."""
+    import jax
+    import numpy as np
+
+    from nds_tpu.engine import ops as E
+    from nds_tpu.listener import drain_stream_events
+    from nds_tpu.obs import trace as obs_trace
+
+    mod = _load_ab_module()
+    queries = mod._STREAM_AB_QUERIES
+    out = []
+    with mod._forced_stream_partitions():
+        with mod._forced_stream_shards() as n_shards:
+            if len(jax.local_devices()) < n_shards:
+                return [], n_shards
+            session = mod._chunked_star_session(np.random.default_rng(42))
+            drain_stream_events()
+            obs_trace.drain_spans()
+            for i in getattr(mod, "_STREAM_AB_SHARDED", ()):
+                sql, _must = queries[i]
+                runs = []
+                for sight in ("cold", "warm"):
+                    before = E.sync_count()
+                    rows = session.sql(sql).collect()
+                    used = E.sync_count() - before
+                    events = drain_stream_events()
+                    records = obs_trace.drain_spans()
+                    coll_spans = [r for r in records
+                                  if getattr(r, "name", "")
+                                  in ("stream.exchange",
+                                      "stream.partition")]
+                    runs.append({
+                        "sight": sight, "syncs": used,
+                        "paths": [e.path for e in events],
+                        "shards": [e.shards for e in events],
+                        "chunks": [e.chunks for e in events],
+                        "collectives": [e.collectives for e in events],
+                        "bytes_ici": [e.bytes_ici for e in events],
+                        "coll_span_syncs": sum(s.syncs
+                                               for s in coll_spans),
+                        "rows": len(rows),
+                    })
+                out.append({"idx": i, "sql": sql,
+                            "cold": runs[0], "warm": runs[1],
+                            "must_partition":
+                            i in mod._STREAM_AB_PARTITIONED})
+    return out, n_shards
+
+
+def compare_sharded(reports, shard_ev, n_shards, inject_drift=False):
+    """Check the static collective budget against the sharded runtime
+    evidence; ``inject_drift`` zeroes the budget first (must fail)."""
+    ok = True
+    lines = []
+    for ev in shard_ev:
+        rep = reports[ev["idx"]]
+        scan = next((s for s in rep.scans if s.compiled), None)
+        head = f"[{rep.query}] sharded S={n_shards}"
+        problems = []
+        if scan is None or scan.shards != n_shards:
+            problems.append(
+                f"model predicts shards="
+                f"{getattr(scan, 'shards', None)}, the sweep forced "
+                f"{n_shards} (model drift)")
+            a2a = fin = 0
+        else:
+            a2a, fin = scan.a2a_chunk, scan.coll_final
+        if inject_drift:
+            a2a = fin = 0
+        for sight in ("cold", "warm"):
+            r = ev[sight]
+            if set(r["paths"]) != {"compiled"}:
+                problems.append(f"{sight} path {r['paths']} != compiled")
+            if set(r["shards"]) - {n_shards}:
+                problems.append(f"{sight} ran shards {r['shards']}, "
+                                f"forced {n_shards}")
+            for coll, chunks in zip(r["collectives"], r["chunks"]):
+                bound = a2a * chunks + fin
+                if coll > bound:
+                    problems.append(
+                        f"{sight} issued {coll} collectives > static "
+                        f"budget {a2a}/chunk x {chunks} + {fin} = {bound}")
+            if ev["must_partition"] and not inject_drift and \
+                    any(c <= 0 for c in r["collectives"]):
+                problems.append(
+                    f"{sight} partitioned sharded run reported "
+                    f"collectives {r['collectives']}: the exchange pass "
+                    "never crossed shards")
+            if r["coll_span_syncs"]:
+                problems.append(
+                    f"{sight} exchange/partition spans charged "
+                    f"{r['coll_span_syncs']} host syncs; the exchange "
+                    "pass must be device-only (0)")
+        if not ev["warm"]["rows"]:
+            problems.append("sharded A/B template returned no rows")
+        if problems:
+            ok = False
+            lines.append(f"MISMATCH {head}")
+            lines.extend(f"    {p}" for p in problems)
+        else:
+            lines.append(
+                f"ok {head} :: warm collectives "
+                f"{ev['warm']['collectives']} <= {a2a}/chunk + {fin}")
+    return ok, lines
 
 
 # Which runtime fallback-reason texts each static reason code explains.
@@ -270,11 +403,27 @@ def compare(reports, evidence, inject_drift=False):
 
 
 def run_diff(inject_drift=False):
-    """Full harness: predict, execute, compare. Returns (ok, lines)."""
+    """Full harness: predict, execute, compare — the single-device sweep
+    plus the sharded collective-budget sweep. Returns (ok, lines)."""
     queries, _ = _load_ab_templates()
     reports = predict(queries)
     evidence = collect_runtime_evidence()
-    return compare(reports, evidence, inject_drift=inject_drift)
+    ok, lines = compare(reports, evidence, inject_drift=inject_drift)
+    shard_ev, n_shards = collect_sharded_evidence()
+    if shard_ev:
+        # sharded predictions run under the forced mesh env, so the
+        # model's collective budget is live (stream_shards_env)
+        mod = _load_ab_module()
+        with mod._forced_stream_partitions():
+            with mod._forced_stream_shards():
+                shard_reports = predict(queries)
+        ok2, lines2 = compare_sharded(shard_reports, shard_ev, n_shards,
+                                      inject_drift=inject_drift)
+        ok = ok and ok2
+        lines.extend(lines2)
+    else:
+        lines.append("# sharded sweep skipped: no multi-device mesh")
+    return ok, lines
 
 
 def main(argv=None) -> int:

@@ -88,9 +88,14 @@ def git_changed_files():
 # io/columnar.py holds the narrow-upload codec rules (encoded columnar
 # execution) that mem_audit's width model mirrors — encoding edits rerun
 # the corpus passes like any other engine-semantics change.
+# nds_tpu/parallel/ holds the mesh/exchange primitives the sharded
+# streamed pipeline compiles (collective accounting, shard_map shims) —
+# exchange/mesh edits rerun the corpus passes because exec_audit's
+# collective budget and mem_audit's per-shard bound mirror them.
 _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/engine", "nds_tpu/schema.py",
-                 "nds_tpu/listener.py", "nds_tpu/io/columnar.py")
+                 "nds_tpu/listener.py", "nds_tpu/io/columnar.py",
+                 "nds_tpu/parallel/")
 
 
 def run_passes(template_dir=None, changed=None, want_reports=False):

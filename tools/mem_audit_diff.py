@@ -41,11 +41,19 @@ a record/trace failure that is not a legitimate routing exception
 re-raises and fails the harness outright, so an engine bug can never
 pose as an eager fallback while the bounds quietly stop being checked.
 
-``--inject-drift`` zeroes every predicted bound — the per-partition
-bounds INCLUDED — before comparing: a model-drift fixture that MUST
-fail in both the whole-scan and the partition direction, proving the
-harness can catch an under-bounding model (``tests/test_analysis.py``
-asserts both directions). Run it after any change to the planner's join
+A SECOND mini-sweep drives the sharded subset (``_STREAM_AB_SHARDED``)
+through the shard_map'd pipeline under a forced 2-shard mesh (the
+shared ``_forced_stream_shards`` context): the runtime shard count must
+equal the model's (``MemModel.shards``), and EVERY per-shard survivor
+count (``StreamEvent.shard_rows``) must fit the proven per-shard bound
+(``mem_audit.shard_row_bound`` — rows/shards × skew through the
+fan-out, the bound the per-shard overflow flags enforce).
+
+``--inject-drift`` zeroes every predicted bound — the per-partition and
+per-SHARD bounds INCLUDED — before comparing: a model-drift fixture
+that MUST fail in the whole-scan, partition and shard directions,
+proving the harness can catch an under-bounding model
+(``tests/test_analysis.py`` asserts both directions). Run it after any change to the planner's join
 bounds, ``ChunkedTable`` chunk shapes, ``engine/stream.py`` accumulator
 sizing or partition plan, or the schema widths: the static model and
 the executor are kept in lockstep the same way ``exec_audit`` tracks
@@ -61,6 +69,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the sharded sweep needs a multi-device mesh: force the virtual CPU
+# devices BEFORE jax initializes (no-op when the caller already did —
+# tests/conftest.py forces 8)
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
 
 
 def _load_ab_module():
@@ -237,12 +253,117 @@ def compare(reports, evidence, inject_drift=False):
     return ok, lines
 
 
+def collect_sharded_evidence():
+    """Drive the sharded subset through the shard_map'd pipeline (forced
+    shard count + partitions) and return (evidence, row bounds, forced
+    shard count); empty evidence without a multi-device mesh."""
+    import jax
+    import numpy as np
+
+    from nds_tpu.listener import drain_stream_events
+
+    mod = _load_ab_module()
+    queries = mod._STREAM_AB_QUERIES
+    out = []
+    with mod._forced_stream_partitions():
+        with mod._forced_stream_shards() as n_shards:
+            if len(jax.local_devices()) < n_shards:
+                return [], {}, n_shards
+            session = mod._chunked_star_session(np.random.default_rng(42))
+            bounds = _session_row_bounds(session)
+            drain_stream_events()
+            for i in getattr(mod, "_STREAM_AB_SHARDED", ()):
+                sql, _must = queries[i]
+                runs = []
+                for sight in ("cold", "warm"):
+                    rows = session.sql(sql).collect()
+                    events = drain_stream_events()
+                    runs.append({
+                        "sight": sight, "out_rows": len(rows),
+                        "paths": [e.path for e in events],
+                        "shards": [e.shards for e in events
+                                   if e.path == "compiled"],
+                        "shard_rows": [list(e.shard_rows) for e in events
+                                       if e.path == "compiled"],
+                    })
+                out.append({"idx": i, "sql": sql,
+                            "cold": runs[0], "warm": runs[1]})
+    return out, bounds, n_shards
+
+
+def compare_sharded(reports, shard_ev, n_shards, inject_drift=False):
+    """Check the static per-shard bounds against the sharded runtime
+    evidence; ``inject_drift`` zeroes them first (must fail)."""
+    ok = True
+    lines = []
+    for ev in shard_ev:
+        rep = reports[ev["idx"]]
+        provable = [s for s in rep.scans if s.provable]
+        shard_bounds = [(s.shards, s.shard_rows) for s in provable]
+        if inject_drift:
+            shard_bounds = [(p, 0 if b is not None else None)
+                            for (p, b) in shard_bounds]
+        head = f"[{rep.query}] sharded S={n_shards}"
+        problems = []
+        for sight in ("cold", "warm"):
+            r = ev[sight]
+            for i, got_s in enumerate(r["shards"]):
+                pred_s, bound = shard_bounds[i] \
+                    if i < len(shard_bounds) else (None, None)
+                if pred_s is None:
+                    problems.append(
+                        f"{sight} compiled scan #{i} has no provable "
+                        "static shard plan (model drift)")
+                    continue
+                if not inject_drift and got_s != pred_s:
+                    problems.append(
+                        f"{sight} ran {got_s} shards, the model chose "
+                        f"{pred_s} (shard plan drift)")
+                if bound is None:
+                    continue
+                for j, n in enumerate(r["shard_rows"][i]):
+                    if n > bound:
+                        problems.append(
+                            f"{sight} shard {j} kept {n} survivor rows "
+                            f"> per-shard bound {bound} (UNSOUND: the "
+                            "proof-sized shard accumulator would have "
+                            "dropped rows)")
+        if not ev["warm"]["out_rows"]:
+            problems.append("sharded A/B template returned no rows")
+        if problems:
+            ok = False
+            lines.append(f"MISMATCH {head}")
+            lines.extend(f"    {p}" for p in problems)
+        else:
+            lines.append(
+                f"ok {head} :: warm shard rows "
+                f"{ev['warm']['shard_rows']} <= "
+                f"{[b for (_p, b) in shard_bounds]}")
+    return ok, lines
+
+
 def run_diff(inject_drift=False):
-    """Full harness: execute, predict from real counts, compare."""
+    """Full harness: execute, predict from real counts, compare — the
+    single-device sweep plus the sharded per-shard-bound sweep."""
     queries, _ = _load_ab_templates()
     evidence, bounds = collect_runtime_evidence()
     reports = predict(queries, bounds)
-    return compare(reports, evidence, inject_drift=inject_drift)
+    ok, lines = compare(reports, evidence, inject_drift=inject_drift)
+    shard_ev, sh_bounds, n_shards = collect_sharded_evidence()
+    if shard_ev:
+        mod = _load_ab_module()
+        with mod._forced_stream_partitions():
+            with mod._forced_stream_shards():
+                # model built under the forced mesh env: MemModel.shards
+                # and the per-shard bounds are live
+                shard_reports = predict(queries, sh_bounds)
+        ok2, lines2 = compare_sharded(shard_reports, shard_ev, n_shards,
+                                      inject_drift=inject_drift)
+        ok = ok and ok2
+        lines.extend(lines2)
+    else:
+        lines.append("# sharded sweep skipped: no multi-device mesh")
+    return ok, lines
 
 
 def main(argv=None) -> int:

@@ -16,14 +16,22 @@ inserts cross-process (gloo, standing in for DCN) collectives where the
 plan needs them — SURVEY.md §5.8 actually executing, where the
 reference's analog is a real Spark/MR cluster run (GenTable.java:120-141).
 
-Two arms:
+Three arms:
 
 1. a full SQL aggregation (scan -> filter -> group -> sort) through the
    Session over ROW-SHARDED tables — argsort re-coding, segment sums and
    the result gather all cross the process boundary;
 2. the ICI/DCN exchange join (`exchange_join_pairs`) driven directly —
    hash bucketize, cross-process all_to_all, local probe, psum'd
-   overflow counters — asserting the exact expected pair count.
+   overflow counters — asserting the exact expected pair count;
+3. a real STREAMED template through the federation: a >HBM-style
+   ChunkedTable scan drives the compiled chunk pipeline
+   (engine/stream.py) SHARDED over each host's local device mesh
+   (NDS_TPU_STREAM_SHARDS=2) while the multi-controller runtime is
+   live — the per-host ICI split of the sharded-streaming design, with
+   DCN federation handling cross-host placement. The launcher asserts
+   the compiled path, the forced shard count, and bit-for-bit rows
+   against a single-process run.
 
 (The full join MATERIALIZATION path is exercised on the single-controller
 8-device mesh instead: XLA:CPU+gloo wedges on the very large
@@ -84,6 +92,51 @@ def exchange_keys():
     return rng.integers(0, EXCHANGE_KEYS, EXCHANGE_N)
 
 
+STREAM_SQL = ("select f_k, count(*) c, sum(f_v) s from f "
+              "where f_v > 100 group by f_k order by f_k")
+
+STREAM_CHUNK_ROWS, STREAM_SHARDS = 2048, 2
+
+
+def make_stream_tables():
+    """Deterministic chunked fact for the streamed arm (4 chunks), built
+    identically on every process and by the launcher's ground truth."""
+    import pyarrow as pa
+    rng = np.random.default_rng(7)
+    n = 8192
+    return pa.table({
+        "f_k": pa.array(rng.integers(0, 25, n), pa.int64()),
+        "f_v": pa.array(rng.integers(0, 1000, n), pa.int64()),
+    })
+
+
+def streamed_arm():
+    """Drive a real streamed template through the compiled chunk
+    pipeline, sharded over this host's local mesh, under the live
+    federation. Returns (rows, stream event) for the launcher to check
+    path/shards/bit-for-bit correctness."""
+    from nds_tpu.engine.session import Session
+    from nds_tpu.engine.table import ChunkedTable
+    from nds_tpu.listener import drain_stream_events
+    os.environ["NDS_TPU_STREAM_SHARDS"] = str(STREAM_SHARDS)
+    os.environ["NDS_TPU_STREAM_STRICT"] = "1"
+    try:
+        sess = Session()
+        sess.create_temp_view(
+            "f", ChunkedTable(make_stream_tables(),
+                              chunk_rows=STREAM_CHUNK_ROWS), base=True)
+        drain_stream_events()
+        rows = sess.sql(STREAM_SQL).collect()
+        events = drain_stream_events()
+        ev = events[0] if events else None
+        return rows, ({"path": ev.path, "shards": ev.shards,
+                       "chunks": ev.chunks, "collectives": ev.collectives}
+                      if ev else None)
+    finally:
+        del os.environ["NDS_TPU_STREAM_SHARDS"]
+        del os.environ["NDS_TPU_STREAM_STRICT"]
+
+
 def exchange_arm(mesh):
     """Direct cross-process exchange join; returns the verified pair
     count (launcher asserts it against the host-side expectation)."""
@@ -115,9 +168,12 @@ def main():
     sess.create_temp_view("a", make_tables())
     rows = sess.sql(SQL).collect()
     pairs = exchange_arm(sess.mesh)
+    stream_rows, stream_ev = streamed_arm()
     if jax.process_index() == 0:
         print(json.dumps({"n_devices": n_dev, "pairs": pairs,
-                          "rows": [list(r) for r in rows]}), flush=True)
+                          "rows": [list(r) for r in rows],
+                          "streamRows": [list(r) for r in stream_rows],
+                          "streamEvent": stream_ev}), flush=True)
     # every process must reach the barrier or the others hang in a
     # collective; sync before exit
     from jax.experimental import multihost_utils

@@ -7,10 +7,14 @@ Reads every ``*.trace.json`` a driver wrote (``nds_power.py --trace-dir``
 
 1. the per-query phase breakdown — self-time per phase (a parent span's
    time minus its children), host-sync count, the compile-vs-drive
-   split of the streamed chunk pipeline, and the encoded-columnar
-   transfer accounting: logical vs actually-uploaded (encoded) bytes
-   per template plus the effective scan GB/s (logical bytes over the
-   stream span's wall time) — compression wins measured, not asserted;
+   split of the streamed chunk pipeline, the collective time of a
+   SHARDED pipeline (``stream.exchange`` — the per-chunk hash-exchange
+   pass — as its own phase column, with the cross-shard reduce inside
+   ``stream.materialize``), and the transfer accounting: logical vs
+   actually-uploaded (encoded) bytes per template plus the effective
+   scan GB/s, and for sharded runs the ICI MB the explicit collectives
+   moved plus the effective ICI GB/s (wire bytes over the collective
+   phase wall) — wins measured, not asserted;
 2. the top sync-charging host-read sites across the run (the first-class
    ``ops.host_read`` call-site tags — which engine lines pay the round
    trips);
@@ -37,10 +41,14 @@ from collections import Counter, defaultdict
 # the eager re-execution after a completed compiled run overflowed its
 # bound buckets — its cost is priced separately in the fallback ranking
 # (the wasted pipeline time is the stream span's remainder).
+# stream.exchange is the sharded pipeline's per-chunk hash-exchange pass
+# (parallel/exchange.py all-to-alls) — the collective-time column; the
+# one cross-shard reduce rides stream.materialize.
 PHASES = ("plan", "replay.record", "replay.compile", "replay.drive",
           "stream.record", "stream.compile", "stream.partition",
-          "stream.prefetch", "stream.drive", "stream.eager",
-          "stream.overflow-rerun", "stream.materialize", "materialize")
+          "stream.exchange", "stream.prefetch", "stream.drive",
+          "stream.eager", "stream.overflow-rerun", "stream.materialize",
+          "materialize")
 
 
 def self_times(events):
@@ -102,7 +110,7 @@ def report(trace_dir, top=10):
         # belongs to the phase span that paid it, not to an "other" row
         spans = self_times([e for e in events if not is_sync(e)])
         row = {"total_ms": 0.0, "syncs": 0, "phases": defaultdict(float),
-               "h2d": 0, "logical": 0, "stream_ms": 0.0}
+               "h2d": 0, "logical": 0, "stream_ms": 0.0, "ici": 0}
         for e in spans:
             name = e["name"]
             args = e.get("args") or {}
@@ -111,11 +119,14 @@ def report(trace_dir, top=10):
             if name == "stream":
                 # encoded-columnar accounting rides the stream span
                 # (engine/stream.py annotates bytesH2d/bytesLogical;
-                # the eager loop annotates bytesH2d only)
+                # the eager loop annotates bytesH2d only; sharded runs
+                # add bytesIci — the explicit collectives' wire bytes)
                 row["h2d"] += args.get("bytesH2d", 0) or 0
                 row["logical"] += args.get("bytesLogical",
                                            args.get("bytesH2d", 0)) or 0
                 row["stream_ms"] += e["dur"] / 1e3
+                ici = args.get("bytesIci", 0) or 0
+                row["ici"] += max(ici, 0)
             if name == "stream.drive":
                 drive_ms += e["self"] / 1e3
                 drive_n += 1
@@ -148,12 +159,16 @@ def report(trace_dir, top=10):
     if any(r["phases"].get("other") for r in per_query.values()):
         used.append("other")
     any_bytes = any(r["logical"] for r in per_query.values())
+    any_ici = any(r["ici"] for r in per_query.values())
     byte_heads = " logical MB | h2d MB | eff GB/s |" if any_bytes else ""
+    ici_heads = " ici MB | ici GB/s |" if any_ici else ""
+    n_cols = (len(used) + 3 + (3 if any_bytes else 0)
+              + (2 if any_ici else 0))
     lines = [f"# trace report: {len(per_query)} queries from {trace_dir}",
              "",
              "| query | total ms | " + " | ".join(used) +
-             " | host syncs |" + byte_heads,
-             "|---" * (len(used) + 3 + (3 if any_bytes else 0)) + "|"]
+             " | host syncs |" + byte_heads + ici_heads,
+             "|---" * n_cols + "|"]
     for q in sorted(per_query):
         r = per_query[q]
         cells = " | ".join(f"{r['phases'].get(p, 0.0):.1f}" for p in used)
@@ -166,6 +181,14 @@ def report(trace_dir, top=10):
                 if r["stream_ms"] else 0.0
             tail = (f" {r['logical'] / 1e6:.1f} | {r['h2d'] / 1e6:.1f} | "
                     f"{gbs:.2f} |")
+        if any_ici:
+            # effective ICI GB/s: the explicit collectives' wire bytes
+            # over the collective phase wall (the exchange pass + the
+            # materialize-time cross-shard reduce)
+            coll_ms = (r["phases"].get("stream.exchange", 0.0)
+                       + r["phases"].get("stream.materialize", 0.0))
+            igbs = (r["ici"] / (coll_ms / 1e3) / 1e9) if coll_ms else 0.0
+            tail += f" {r['ici'] / 1e6:.1f} | {igbs:.2f} |"
         lines.append(f"| {q} | {r['total_ms']:.1f} | {cells} | "
                      f"{r['syncs']} |" + tail)
     comp = sum(r["phases"].get("stream.compile", 0.0)
