@@ -74,6 +74,12 @@ if __name__ == "__main__":
                         "files from the engine's span tracer (load in "
                         "chrome://tracing or Perfetto; aggregate with "
                         "tools/trace_report.py). Zero added host syncs.")
+    parser.add_argument("--ledger",
+                        help="campaign evidence ledger file (append-only "
+                        "JSONL, nds_tpu/obs/ledger.py): one validated "
+                        "record per query, flushed as it lands, plus a "
+                        "terminal end record — the input to "
+                        "tools/bench_compare.py. Also via NDS_TPU_LEDGER.")
     parser.add_argument("--warm",
                         action="store_true",
                         help="precompile pass: execute the stream once to "
@@ -105,4 +111,5 @@ if __name__ == "__main__":
                      args.allow_failure,
                      profile_folder=args.profile,
                      warm=args.warm,
-                     trace_dir=args.trace_dir)
+                     trace_dir=args.trace_dir,
+                     ledger_path=args.ledger)

@@ -194,6 +194,18 @@ def stream_event_json(e: StreamEvent) -> dict:
     }
 
 
+def stream_evidence(events) -> dict:
+    """Aggregate drained :class:`StreamEvent` objects into the compact
+    per-query evidence dict the campaign ledger records
+    (:mod:`nds_tpu.obs.ledger`): total syncs/chunks, h2d upload and ICI
+    wire bytes, partition/shard/collective counts, the compiled-vs-eager
+    path split and the fallback reasons. Same aggregation as
+    ``ledger.evidence_from_scans`` runs over the JSON shape — this is
+    the in-process form for drivers that hold the live events."""
+    from nds_tpu.obs.ledger import evidence_from_scans
+    return evidence_from_scans([stream_event_json(e) for e in events])
+
+
 def report_task_failure(where: str, exc: BaseException | str,
                         fatal: bool = False) -> None:
     """Engine-side hook: call on any retried partition task, capacity

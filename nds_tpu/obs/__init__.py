@@ -17,8 +17,16 @@ pays; ``tests/test_obs.py`` proves sync-count parity traced vs untraced).
 * :mod:`nds_tpu.obs.export` — Chrome ``trace_event`` export
   (``chrome://tracing`` / Perfetto) and the per-query rollup dict the
   drivers merge into their JSON summaries.
+* :mod:`nds_tpu.obs.ledger` — the campaign evidence ledger: the
+  schema-versioned, flush-per-query, append-only JSONL record both
+  drivers write and every post-hoc tool (``tools/bench_compare.py``,
+  ``tools/trace_report.py``, ``tools/sync_profile.py``) reads, plus the
+  campaign heartbeat thread.
 """
 
+from nds_tpu.obs.ledger import (LEDGER_VERSION, Heartbeat,  # noqa: F401
+                                Ledger, LedgerData, LedgerError,
+                                evidence_from_scans, load_ledger)
 from nds_tpu.obs.trace import (NULL_SPAN, SpanRecord, SyncSite,  # noqa: F401
                                annotate, attach, drain_spans, on,
                                set_enabled, span, unattributed)

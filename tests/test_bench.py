@@ -157,6 +157,116 @@ def test_first_partial_run_seeds_baseline(tmp_path):
     assert abs(vs2 - 2.0) < 1e-9
 
 
+def test_derive_budgets_from_baseline(tmp_path):
+    """Per-query budgets: baseline wall x headroom, clamped to
+    [floor, cap]; queries with no history keep the cap (their first
+    measurement must not be killed by a budget nobody derived)."""
+    f = tmp_path / "base.json"
+    json.dump({"times": {"q_cheap": 10.0, "q_mid": 2000.0,
+                         "q_heavy": 200000.0}}, open(f, "w"))
+    budgets = bench.derive_budgets(
+        ["q_cheap", "q_mid", "q_heavy", "q_new"], str(f),
+        headroom=30.0, floor_s=30.0, cap_s=100.0)
+    assert budgets["q_cheap"] == 30.0        # floor absorbs cold compile
+    assert budgets["q_mid"] == 60.0          # 2 s x 30
+    assert budgets["q_heavy"] == 100.0       # capped at the old allowance
+    assert budgets["q_new"] == 100.0         # no history -> cap
+    # a missing/unreadable baseline derives nothing: every query keeps
+    # the cap (never a zero budget)
+    budgets = bench.derive_budgets(["q1"], str(tmp_path / "nope.json"),
+                                   headroom=30.0, floor_s=30.0,
+                                   cap_s=100.0)
+    assert budgets == {"q1": 100.0}
+
+
+def test_derive_budgets_off_at_foreign_scale(tmp_path, monkeypatch):
+    """The committed baseline is bench-scale (0.05) history: at SF10 the
+    walls are incommensurable (minutes/query), so derivation must stay
+    OFF — every query keeps the cap — unless the operator sets the
+    headroom explicitly for that campaign."""
+    monkeypatch.delenv("NDS_BENCH_BUDGET_HEADROOM", raising=False)
+    f = tmp_path / "base.json"
+    json.dump({"times": {"q1": 800.0}}, open(f, "w"))
+    assert bench.derive_budgets(["q1"], str(f), floor_s=30.0, cap_s=400.0,
+                                scale="10") == {"q1": 400.0}
+    # bench scale: derivation active
+    assert bench.derive_budgets(["q1"], str(f), floor_s=30.0, cap_s=400.0,
+                                scale="0.05") == {"q1": 30.0}
+    # explicit opt-in at scale: active again
+    monkeypatch.setenv("NDS_BENCH_BUDGET_HEADROOM", "200")
+    assert bench.derive_budgets(["q1"], str(f), floor_s=30.0, cap_s=400.0,
+                                scale="10") == {"q1": 160.0}
+
+
+def test_budget_enforcement_hung_child(tmp_path, monkeypatch, capsys):
+    """The BENCH_r05 failure mode, pinned as a regression: one query
+    hangs past its DERIVED budget — the round must finish, with that
+    query marked ``timeout`` in the ledger, a NON-NULL geomean over the
+    completed queries, and finalize()'s output complete (PERF.md + a
+    terminal ``completed`` record; the hang cost its budget, not the
+    campaign)."""
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "ensure_data", lambda: None)
+    monkeypatch.setattr(bench, "bench_queries",
+                        lambda: [("query1", "s1"), ("query2", "s2"),
+                                 ("query3", "s3")])
+    monkeypatch.setattr(bench, "_emitted", False)
+    json.dump({"times": {"query1": 100.0, "query2": 1000.0,
+                         "query3": 100.0}},
+              open(tmp_path / "BASELINE_TIMES.json", "w"))
+    ledger_path = tmp_path / "campaign.jsonl"
+    monkeypatch.setenv("NDS_BENCH_RESULTS_JSONL", str(ledger_path))
+    monkeypatch.setenv("NDS_BENCH_BUDGET_FLOOR_S", "5")
+    monkeypatch.setenv("NDS_BENCH_BUDGET_HEADROOM", "2")
+    monkeypatch.setenv("NDS_BENCH_HEARTBEAT_S", "0")   # deterministic file
+
+    deadlines = {}
+
+    class HangingChild:
+        def __init__(self):
+            self.proc = None
+            self.started = False
+
+        def alive(self):
+            return self.started
+
+        def start(self, deadline_left):
+            self.started = True
+            return {"ready": True, "platform": "axon"}
+
+        def run_query(self, name, timeout):
+            deadlines.setdefault(name, timeout)
+            if name == "query2":
+                return None        # hung in-flight: supervisor's timeout
+            return {"name": name, "ms": 100.0, "hostSyncs": 1,
+                    "syncWaitMs": 1.0}
+
+        def stop(self):
+            self.started = False   # the hung child gets killed
+
+    monkeypatch.setattr(bench, "ChildServer", HangingChild)
+    import time as _time
+    bench.run_parent(_time.perf_counter())
+    out = capsys.readouterr()
+    msg = json.loads(out.out.strip().splitlines()[-1])
+    assert msg["n_queries"] == 2
+    assert msg["value"] == pytest.approx(100.0)        # non-null geomean
+    assert "aborted" not in msg                        # the round FINISHED
+    # the derived budget was enforced: query2's baseline wall (1 s) x
+    # headroom 2 = 2 s, floored at 5 s — not the 420 s global cap
+    assert deadlines["query2"] == pytest.approx(5.0)
+    assert "timeout after 5s (budget)" in out.err
+    data = bench.ledger_mod().load_ledger(str(ledger_path))
+    assert data.queries["query2"]["status"] == "timeout"
+    assert [r["status"] for r in data.attempts
+            if r["name"] == "query2"] == ["timeout", "timeout"]
+    assert data.queries["query2"]["budgetS"] == pytest.approx(5.0)
+    assert data.times() == {"query1": 100.0, "query3": 100.0}
+    assert data.complete() and data.end["status"] == "completed"
+    assert data.end["queries"] == 2 and data.end["platform"] == "axon"
+    assert "query1" in open(tmp_path / "PERF.md").read()
+
+
 def test_setup_timeout_circuit_breaker(monkeypatch, capsys):
     """Two consecutive child-setup failures must trip the breaker: stop
     burning budget and emit a LABELED partial artifact (BENCH_r05 spent
@@ -200,13 +310,18 @@ def test_external_timeout_flushes_partial_geomean(tmp_path, monkeypatch,
     the partial geomean of every COMPLETED query — PERF.md + metric line
     — not BENCH_r05's {"value": null, "n_queries": 0}. Simulated: the
     child serves query1, then the SIGTERM handler fires while query2 is
-    in flight."""
+    in flight. The handler must also close the ledger with a terminal
+    ``aborted`` record (reason: signal) so the artifact is
+    self-describing — a resume sees query1 done, query2 unfinished."""
     monkeypatch.setattr(bench, "REPO", str(tmp_path))
     monkeypatch.setattr(bench, "ensure_data", lambda: None)
     monkeypatch.setattr(bench, "bench_queries",
                         lambda: [("query1", "select 1"),
                                  ("query2", "select 2")])
     monkeypatch.setattr(bench, "_emitted", False)
+    ledger_path = tmp_path / "campaign.jsonl"
+    monkeypatch.setenv("NDS_BENCH_RESULTS_JSONL", str(ledger_path))
+    monkeypatch.setenv("NDS_BENCH_HEARTBEAT_S", "0")
 
     handlers = {}
     monkeypatch.setattr(bench.signal, "signal",
@@ -250,6 +365,130 @@ def test_external_timeout_flushes_partial_geomean(tmp_path, monkeypatch,
     assert msg["value"] == pytest.approx(123.0)
     perf_text = open(tmp_path / "PERF.md").read()
     assert "query1" in perf_text and "platform: axon." in perf_text
+    # terminal ledger record: the kill is labeled, not inferred
+    data = bench.ledger_mod().load_ledger(str(ledger_path))
+    assert data.times() == {"query1": 123.0}
+    assert data.complete() and data.end["status"] == "aborted"
+    assert data.end["reason"] == "signal"
+    assert data.end["queries"] == 1 and data.end["platform"] == "axon"
+
+
+def test_round_budget_exhaustion_labeled_truthfully(tmp_path, monkeypatch,
+                                                    capsys):
+    """A healthy query killed because the ROUND's budget ran out must be
+    labeled 'round-budget', not blamed on a per-query budget that never
+    limited it (the ledger is the durable post-hoc record — the cause
+    must be the real one)."""
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "ensure_data", lambda: None)
+    monkeypatch.setattr(bench, "bench_queries",
+                        lambda: [("query1", "s1")])
+    monkeypatch.setattr(bench, "_emitted", False)
+    # round budget leaves ~8s; the per-query floor is far larger, so the
+    # deadline is the round remainder, not the derived budget
+    monkeypatch.setenv("NDS_BENCH_BUDGET_S", "28")
+    monkeypatch.setenv("NDS_BENCH_RESULTS_JSONL",
+                       str(tmp_path / "led.jsonl"))
+    monkeypatch.setenv("NDS_BENCH_HEARTBEAT_S", "0")
+
+    class HungChild:
+        def __init__(self):
+            self.proc = None
+            self.started = False
+
+        def alive(self):
+            return self.started
+
+        def start(self, deadline_left):
+            self.started = True
+            return {"ready": True, "platform": "axon"}
+
+        def run_query(self, name, timeout):
+            return None                  # hung until the deadline
+
+        def stop(self):
+            self.started = False
+
+    monkeypatch.setattr(bench, "ChildServer", HungChild)
+    import time as _time
+    with pytest.raises(SystemExit):      # nothing measured -> exit 1
+        bench.run_parent(_time.perf_counter())
+    err = capsys.readouterr().err
+    assert "(round-budget)" in err and "(budget)" not in err
+    data = bench.ledger_mod().load_ledger(str(tmp_path / "led.jsonl"))
+    assert data.queries["query1"]["status"] == "timeout"
+    assert "round-budget" in data.queries["query1"]["error"]
+
+
+def test_round_with_hang_and_sigterm_still_yields_ledger(
+        tmp_path, monkeypatch, capsys):
+    """The acceptance scenario end to end: ONE round suffers an injected
+    hang (query2 blows its derived budget) AND an injected SIGTERM
+    (while query4 is in flight) — and still produces a complete ledger
+    (timeout attempt + terminal aborted record), a non-null geomean over
+    the completed queries, and a regenerated PERF.md."""
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    monkeypatch.setattr(bench, "ensure_data", lambda: None)
+    monkeypatch.setattr(bench, "bench_queries",
+                        lambda: [(f"query{i}", f"s{i}")
+                                 for i in (1, 2, 3, 4)])
+    monkeypatch.setattr(bench, "_emitted", False)
+    json.dump({"times": {f"query{i}": 100.0 * i for i in (1, 2, 3, 4)}},
+              open(tmp_path / "BASELINE_TIMES.json", "w"))
+    ledger_path = tmp_path / "campaign.jsonl"
+    monkeypatch.setenv("NDS_BENCH_RESULTS_JSONL", str(ledger_path))
+    monkeypatch.setenv("NDS_BENCH_BUDGET_FLOOR_S", "5")
+    monkeypatch.setenv("NDS_BENCH_HEARTBEAT_S", "0")
+
+    handlers = {}
+    monkeypatch.setattr(bench.signal, "signal",
+                        lambda signum, fn: handlers.setdefault(signum, fn))
+
+    def fake_exit(code):
+        raise SystemExit(code)
+
+    monkeypatch.setattr(bench.os, "_exit", fake_exit)
+
+    class ChaosChild:
+        def __init__(self):
+            self.proc = None
+            self.started = False
+
+        def alive(self):
+            return self.started
+
+        def start(self, deadline_left):
+            self.started = True
+            return {"ready": True, "platform": "axon"}
+
+        def run_query(self, name, timeout):
+            if name == "query2":
+                return None              # the injected hang
+            if name == "query4":
+                # the injected external kill, mid-flight
+                handlers[bench.signal.SIGTERM](bench.signal.SIGTERM, None)
+                raise AssertionError("handler must not return")
+            return {"name": name, "ms": 100.0, "hostSyncs": 1,
+                    "syncWaitMs": 1.0}
+
+        def stop(self):
+            self.started = False
+
+    monkeypatch.setattr(bench, "ChildServer", ChaosChild)
+    import time as _time
+    with pytest.raises(SystemExit):
+        bench.run_parent(_time.perf_counter())
+    out = capsys.readouterr()
+    msg = json.loads(out.out.strip().splitlines()[-1])
+    assert msg["n_queries"] == 2
+    assert msg["value"] == pytest.approx(100.0)        # non-null geomean
+    data = bench.ledger_mod().load_ledger(str(ledger_path))
+    assert data.times() == {"query1": 100.0, "query3": 100.0}
+    assert data.queries["query2"]["status"] == "timeout"
+    assert data.complete() and data.end["status"] == "aborted"
+    assert data.end["reason"] == "signal" and data.end["queries"] == 2
+    perf_text = open(tmp_path / "PERF.md").read()
+    assert "query1" in perf_text and "query3" in perf_text
 
 
 def test_write_perf_stamps_platform_and_streamed(tmp_path, monkeypatch):

@@ -46,7 +46,11 @@ def test_tracing_adds_zero_syncs():
     read host clocks and existing counters only, never the device. Both
     arms rebuild their session from the same seed and run cold (the
     pipeline/rank caches key on buffer identity, so fresh sessions miss
-    equally)."""
+    equally). The TRACED arm additionally runs under a live campaign
+    heartbeat (nds_tpu/obs/ledger.py) whose status callable reads the
+    sync counters — the heartbeat thread is part of the zero-added-sync
+    contract now that bench.py runs one for the whole campaign."""
+    from nds_tpu.obs.ledger import Heartbeat
     queries, make_session = _synccount_fixtures()
     ab = [q for q, _must in queries[:2]]
     assert obs_trace.on(), "tracing must be default-on"
@@ -62,14 +66,19 @@ def test_tracing_adds_zero_syncs():
             assert rows
         return out
 
-    traced = run_arm()
+    hb = Heartbeat(0.01, ledger=None,
+                   status=lambda: {"syncs": E.sync_count()}, out=None)
+    with hb:
+        traced = run_arm()
+    assert hb.beats > 0, "heartbeat must have fired during the arm"
     obs_trace.set_enabled(False)
     try:
         untraced = run_arm()
     finally:
         obs_trace.set_enabled(True)
     assert traced == untraced, \
-        f"tracing changed sync counts: traced={traced} untraced={untraced}"
+        f"tracing (+heartbeat) changed sync counts: " \
+        f"traced={traced} untraced={untraced}"
     obs_trace.drain_spans()                     # leftovers from this test
 
 
@@ -359,3 +368,39 @@ def test_power_run_writes_trace_files(tmp_path, monkeypatch):
         summary = json.load(f)
     assert "plan" in summary["trace"]["phases"]
     assert "syncSites" in summary["trace"]
+
+
+def test_power_run_writes_ledger(tmp_path, monkeypatch):
+    """The Power driver with a ledger path must append one validated
+    query record per query (phase rollup + sync counters aboard) and a
+    terminal ``completed`` record — the campaign evidence ledger is the
+    durable unification of what the JSON summaries record per file."""
+    import pyarrow.parquet as pq
+    from collections import OrderedDict
+
+    from nds_tpu import power
+    from nds_tpu.obs.ledger import load_ledger
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.types import to_arrow as to_pa
+    fields = get_schemas(use_decimal=True)["item"]
+    monkeypatch.setattr(power, "get_schemas",
+                        lambda use_decimal: {"item": fields})
+    data = tmp_path / "data"
+    (data / "item").mkdir(parents=True)
+    cols = {f.name: pa.array([None, None], to_pa(f.type)) for f in fields}
+    cols["i_item_sk"] = pa.array([1, 2], to_pa(fields[0].type))
+    pq.write_table(pa.table(cols), data / "item" / "part-0.parquet")
+    ledger_path = tmp_path / "campaign.jsonl"
+    power.run_query_stream(str(data), None,
+                           OrderedDict(q="select count(*) c from item"),
+                           str(tmp_path / "t.csv"),
+                           ledger_path=str(ledger_path))
+    led = load_ledger(str(ledger_path))
+    assert led.meta["driver"] == "power"
+    assert led.complete() and led.end["status"] == "completed"
+    assert led.end["queries"] == 1
+    rec = led.queries["q"]
+    assert rec["status"] == "ok" and rec["ms"] >= 0
+    assert rec["phase"] == "Power"
+    assert "hostSyncs" in rec and "compileMs" in rec
+    assert "plan" in rec["tracePhases"]["phases"]

@@ -1,0 +1,30 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Shared jax-free loader for the campaign evidence ledger module.
+
+``nds_tpu/obs/ledger.py`` is deliberately stdlib-only, but importing it
+as ``nds_tpu.obs.ledger`` executes the package root, which imports jax —
+unacceptable for the bench.py parent (the device attachment belongs to
+the serving child alone) and needless weight for post-hoc tools. This
+helper loads the module BY FILE PATH, once, cached under a canonical
+``sys.modules`` name so every caller shares one module object (isinstance
+checks across callers stay valid).
+"""
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NAME = "_nds_ledger_stdlib"
+
+
+def ledger_mod():
+    """The ledger module, loaded without touching the jax import."""
+    mod = sys.modules.get(_NAME)
+    if mod is None:
+        spec = importlib.util.spec_from_file_location(
+            _NAME, os.path.join(REPO, "nds_tpu", "obs", "ledger.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[_NAME] = mod
+        spec.loader.exec_module(mod)
+    return mod

@@ -92,10 +92,14 @@ def git_changed_files():
 # streamed pipeline compiles (collective accounting, shard_map shims) —
 # exchange/mesh edits rerun the corpus passes because exec_audit's
 # collective budget and mem_audit's per-shard bound mirror them.
+# nds_tpu/obs/ holds the span tracer, exporters AND the campaign
+# evidence ledger — the runtime evidence layer the differential
+# harnesses check the audits against; ledger/export edits rerun the
+# corpus passes so span-in-jit and friends stay enforced on them.
 _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/engine", "nds_tpu/schema.py",
                  "nds_tpu/listener.py", "nds_tpu/io/columnar.py",
-                 "nds_tpu/parallel/")
+                 "nds_tpu/parallel/", "nds_tpu/obs/")
 
 
 def run_passes(template_dir=None, changed=None, want_reports=False):

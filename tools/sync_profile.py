@@ -14,6 +14,16 @@ charged its syncs to BOTH frames. The first-class counters attribute each
 sync to exactly one site.
 
 Usage: JAX_PLATFORMS=cpu python tools/sync_profile.py query9 query83 ...
+
+Post-hoc mode: pass a campaign evidence ledger file
+(``nds_tpu/obs/ledger.py`` — a bench.py resume JSONL or an
+``nds_power.py --ledger`` file) as the first argument and the profiler
+prints each recorded query's sync-site histogram from the ledger's
+``tracePhases.syncSites`` rollup (the top sites per query as recorded)
+instead of re-running anything — any completed round stays analyzable
+after the fact::
+
+    python tools/sync_profile.py BENCH_LEDGER.jsonl [query9 ...]
 """
 
 import collections
@@ -42,8 +52,37 @@ def site_histogram(records) -> "collections.Counter":
     return sites
 
 
+def ledger_histograms(path, wanted=()):
+    """Per-query sync-site histograms from a completed round's ledger
+    (the recorded ``tracePhases.syncSites`` rollup — the top sites per
+    query; the FULL histogram needs a live run). Returns print lines."""
+    from tools._ledger_load import ledger_mod   # stdlib-only: no jax
+    data = ledger_mod().load_ledger(path)
+    lines = []
+    for name in sorted(data.queries):
+        if wanted and name not in wanted:
+            continue
+        rec = data.queries[name]
+        roll = rec.get("tracePhases") or rec.get("trace") or {}
+        sites = roll.get("syncSites") or []
+        used = rec.get("hostSyncs", sum(s.get("syncs", 0) for s in sites))
+        lines.append(f"\n== {name}: {used} syncs "
+                     f"(top {len(sites)} sites as recorded) ==")
+        for s in sorted(sites, key=lambda s: -s.get("syncs", 0)):
+            lines.append(f"  {s.get('syncs', 0):3d}  "
+                         f"{s.get('tag', '?'):12s} {s.get('site', '?')}")
+    if not lines:
+        lines.append(f"# no completed query records in ledger {path}")
+    return lines
+
+
 def main():
     wanted = sys.argv[1:]
+    if wanted and os.path.isfile(wanted[0]):
+        # post-hoc: a ledger file instead of query names
+        for ln in ledger_histograms(wanted[0], set(wanted[1:])):
+            print(ln)
+        return
     from nds_tpu.engine import ops as E
     from nds_tpu.engine.session import Session
     from nds_tpu.obs import trace as obs_trace

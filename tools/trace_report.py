@@ -20,9 +20,26 @@ Reads every ``*.trace.json`` a driver wrote (``nds_power.py --trace-dir``
    trips);
 3. the eager-fallback cost ranking by reason — the measured worklist for
    ROADMAP's streamability widening (each line is wall time + syncs a
-   query paid because the compiled pipeline rejected it).
+   query paid because the compiled pipeline rejected it);
+4. ROOFLINE columns — each query's effective scan GB/s as a percentage
+   of ``NDS_TPU_ROOFLINE_HBM_GBS`` and its ICI GB/s as a percentage of
+   ``NDS_TPU_ROOFLINE_ICI_GBS`` (defaults are v5e-class: 819 / 186;
+   set them for the attached part) — so "is the scan fast?" reads off
+   the table instead of requiring the chip datasheet;
+5. a ranked NEXT-BOTTLENECK summary — host-sync blocking, eager
+   fallbacks, compile time, HBM-roofline headroom and ICI-roofline
+   headroom, each priced in attributable milliseconds across the run —
+   ROADMAP's "name the next bottleneck from data" as one command.
 
-Usage: python tools/trace_report.py TRACE_DIR [--top N]
+The input may be a ``--trace-dir`` of per-query Chrome traces OR a
+campaign evidence ledger file (``nds_tpu/obs/ledger.py`` — bench.py
+resume / ``nds_power.py --ledger``): ledger query records carry the
+same ``tracePhases`` rollup and streamed-scan evidence, so post-hoc
+analysis works on any completed round without re-running it. Ledger
+rows price phases from the recorded rollup (inclusive span times, not
+self-times) and use uploaded (encoded) bytes as the logical volume.
+
+Usage: python tools/trace_report.py TRACE_DIR_OR_LEDGER [--top N]
 """
 
 import argparse
@@ -31,6 +48,13 @@ import json
 import os
 import sys
 from collections import Counter, defaultdict
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# per-chip roofline knobs for the % columns and the bottleneck ranking;
+# defaults are v5e-class numbers — override for the attached part
+ROOFLINE_HBM_GBS = float(os.environ.get("NDS_TPU_ROOFLINE_HBM_GBS", "819"))
+ROOFLINE_ICI_GBS = float(os.environ.get("NDS_TPU_ROOFLINE_ICI_GBS", "186"))
 
 # phase columns of the breakdown table, in pipeline order; everything
 # else (query/stream umbrellas, uncovered wall) folds into "other".
@@ -76,22 +100,32 @@ def load_trace(path):
     return query, doc.get("traceEvents") or []
 
 
-def report(trace_dir, top=10):
-    """Aggregate a trace dir; returns the printable lines."""
+def _new_agg():
+    return {
+        "per_query": {},
+        "sites": Counter(),
+        "site_tag": {},
+        "fallbacks": defaultdict(lambda: {"queries": 0, "ms": 0.0,
+                                          "syncs": 0, "rerun_ms": 0.0,
+                                          "chunks": 0}),
+        # compiled-path unit costs measured from THIS run's streamed
+        # statements: the basis of the projected-savings column (what an
+        # eager fallback would roughly cost compiled — per-chunk drive
+        # time of comparable pipelines plus one materialize)
+        "drive_ms": 0.0, "drive_n": 0, "mat_ms": 0.0, "mat_n": 0,
+    }
+
+
+def collect_from_traces(trace_dir):
+    """Aggregate a --trace-dir of Chrome traces; None when empty."""
     files = sorted(glob.glob(os.path.join(trace_dir, "*.trace.json")))
     if not files:
-        return [f"# no *.trace.json files under {trace_dir}"]
-    per_query = {}
-    sites = Counter()
-    site_tag = {}
-    fallbacks = defaultdict(lambda: {"queries": 0, "ms": 0.0, "syncs": 0,
-                                     "rerun_ms": 0.0, "chunks": 0})
-    # compiled-path unit costs measured from THIS run's streamed
-    # statements: the basis of the projected-savings column (what an
-    # eager fallback would roughly cost compiled — per-chunk drive time
-    # of comparable pipelines plus one materialize)
-    drive_ms, drive_n = 0.0, 0
-    mat_ms, mat_n = 0.0, 0
+        return None
+    agg = _new_agg()
+    per_query = agg["per_query"]
+    sites = agg["sites"]
+    site_tag = agg["site_tag"]
+    fallbacks = agg["fallbacks"]
     for path in files:
         query, events = load_trace(path)
 
@@ -99,18 +133,21 @@ def report(trace_dir, top=10):
             return e.get("cat") == "sync" or e["name"].startswith("sync:")
 
         query_syncs = 0
+        query_sync_ms = 0.0
         for e in events:
             if e.get("ph") == "X" and is_sync(e):
                 args = e.get("args") or {}
                 site = args.get("site", "?")
                 sites[site] += args.get("syncs", 0)
                 query_syncs += args.get("syncs", 0)
+                query_sync_ms += e.get("dur", 0.0) / 1e3
                 site_tag.setdefault(site, e["name"].split("sync:")[-1])
         # sync slices are excluded from the span tree: their blocked time
         # belongs to the phase span that paid it, not to an "other" row
         spans = self_times([e for e in events if not is_sync(e)])
         row = {"total_ms": 0.0, "syncs": 0, "phases": defaultdict(float),
-               "h2d": 0, "logical": 0, "stream_ms": 0.0, "ici": 0}
+               "h2d": 0, "logical": 0, "stream_ms": 0.0, "ici": 0,
+               "sync_ms": 0.0}
         for e in spans:
             name = e["name"]
             args = e.get("args") or {}
@@ -128,11 +165,11 @@ def report(trace_dir, top=10):
                 ici = args.get("bytesIci", 0) or 0
                 row["ici"] += max(ici, 0)
             if name == "stream.drive":
-                drive_ms += e["self"] / 1e3
-                drive_n += 1
+                agg["drive_ms"] += e["self"] / 1e3
+                agg["drive_n"] += 1
             if name == "stream.materialize":
-                mat_ms += e["self"] / 1e3
-                mat_n += 1
+                agg["mat_ms"] += e["self"] / 1e3
+                agg["mat_n"] += 1
             if name == "stream" and args.get("path") == "eager":
                 fb = fallbacks[args.get("reason", "?")]
                 fb["queries"] += 1
@@ -152,19 +189,155 @@ def report(trace_dir, top=10):
         tops = [e for e in spans if e["top"]]
         row["total_ms"] = sum(e["dur"] for e in tops) / 1e3
         row["syncs"] = query_syncs
+        row["sync_ms"] = query_sync_ms
         per_query[query] = row
+    return agg
 
+
+def collect_from_ledger(path):
+    """Build the same aggregate from a campaign evidence ledger: query
+    records carry the ``tracePhases`` rollup (per-phase inclusive ms /
+    counts / syncs, top sync sites, fallbacks) and the streamed-scan
+    evidence (bytesH2d/bytesIci) — enough for the phase table, roofline
+    columns and bottleneck ranking without the original trace dir.
+    Phase times are the rollup's INCLUSIVE span totals (children
+    included), and uploaded bytes stand in for logical volume."""
+    sys.path.insert(0, REPO)
+    from tools._ledger_load import ledger_mod   # stdlib-only: no jax
+    data = ledger_mod().load_ledger(path)
+    if not data.queries:
+        return None
+    agg = _new_agg()
+    per_query = agg["per_query"]
+    for name, rec in sorted(data.queries.items()):
+        if rec["status"] != "ok":
+            continue
+        roll = rec.get("tracePhases") or rec.get("trace") or {}
+        phases = roll.get("phases") or {}
+        row = {"total_ms": rec.get("ms", 0.0), "syncs": 0,
+               "phases": defaultdict(float), "h2d": 0, "logical": 0,
+               "stream_ms": 0.0, "ici": 0,
+               "sync_ms": rec.get("syncWaitMs", 0.0)}
+        # rollup phase times are INCLUSIVE, so the umbrella spans —
+        # 'query' (wraps everything) and 'stream' (wraps the chunk
+        # pipeline) — must not fold into columns next to their own
+        # children: that would double-count the whole wall into
+        # 'other'. 'plan' IS a column, so approximate its self-time by
+        # subtracting its known direct children (the stream umbrella
+        # and the replay phases).
+        incl = {n: p.get("ms", 0.0) for n, p in phases.items()}
+        plan_children = incl.get("stream", 0.0) + sum(
+            incl.get(n, 0.0) for n in ("replay.record", "replay.compile",
+                                       "replay.drive"))
+        for pname, p in phases.items():
+            ms = p.get("ms", 0.0)
+            if pname == "stream":
+                row["stream_ms"] += ms
+            if pname in ("query", "stream"):
+                continue                 # umbrellas: time is in children
+            if pname == "plan":
+                ms = max(ms - plan_children, 0.0)
+            row["phases"][pname if pname in PHASES else "other"] += ms
+            if pname == "stream.drive":
+                agg["drive_ms"] += ms
+                agg["drive_n"] += p.get("count", 0)
+            if pname == "stream.materialize":
+                agg["mat_ms"] += ms
+                agg["mat_n"] += p.get("count", 0)
+        # driver-measured XLA compile (the jax monitoring meter): richer
+        # than the span phases when the compile happened outside a
+        # stream/replay compile span (e.g. eager table-at-a-time ops)
+        row["compile_ms"] = rec.get("compileMs",
+                                    rec.get("compileS", 0.0) * 1e3)
+        ev = rec.get("evidence") or {}
+        row["h2d"] = max(ev.get("bytesH2d", 0), 0)
+        row["logical"] = row["h2d"]
+        row["ici"] = max(ev.get("bytesIci", 0), 0)
+        row["syncs"] = rec.get("hostSyncs",
+                               sum(p.get("syncs", 0)
+                                   for p in phases.values()))
+        for site in roll.get("syncSites") or []:
+            agg["sites"][site.get("site", "?")] += site.get("syncs", 0)
+            agg["site_tag"].setdefault(site.get("site", "?"),
+                                       site.get("tag", "?"))
+        for fb_rec in roll.get("fallbacks") or []:
+            fb = agg["fallbacks"][fb_rec.get("reason", "?")]
+            fb["queries"] += 1
+            fb["ms"] += fb_rec.get("ms", 0.0)
+            fb["syncs"] += fb_rec.get("syncs", 0)
+        per_query[name] = row
+    return agg if per_query else None
+
+
+def bottlenecks(agg):
+    """Rank the run's improvement levers by ATTRIBUTABLE milliseconds —
+    ROADMAP's "name the next bottleneck from data". Candidates: host-sync
+    blocking (measured blocked ms), eager fallbacks (measured fallback
+    ms), XLA compile (measured compile-phase ms), HBM headroom (streamed
+    scan ms x the fraction of the HBM roofline unused), ICI headroom
+    (collective ms x the fraction of the ICI roofline unused)."""
+    per_query = agg["per_query"].values()
+    out = []
+    sync_ms = sum(r["sync_ms"] for r in per_query)
+    if sync_ms > 0:
+        out.append((sync_ms, "host-sync blocking",
+                    "reduce round trips (DESIGN.md sync inventory)"))
+    fb_ms = sum(fb["ms"] for fb in agg["fallbacks"].values())
+    if fb_ms > 0:
+        out.append((fb_ms, "eager fallbacks",
+                    "widen streamability (fallback ranking below)"))
+    # per row, the larger of span-phase compile and the driver's compile
+    # meter (ledger rows) — the meter covers compiles no span wraps
+    compile_ms = sum(max(r["phases"].get("stream.compile", 0.0)
+                         + r["phases"].get("replay.compile", 0.0),
+                         r.get("compile_ms", 0.0))
+                     for r in per_query)
+    if compile_ms > 0:
+        out.append((compile_ms, "XLA compile",
+                    "persistent cache / template bank (ROADMAP item 5)"))
+    stream_ms = sum(r["stream_ms"] for r in per_query)
+    logical = sum(r["logical"] for r in per_query)
+    if stream_ms > 0 and logical > 0:
+        gbs = logical / (stream_ms / 1e3) / 1e9
+        frac = min(gbs / ROOFLINE_HBM_GBS, 1.0)
+        out.append((stream_ms * (1.0 - frac),
+                    f"HBM roofline headroom (scans at {gbs:.1f} GB/s = "
+                    f"{frac * 100:.1f}% of {ROOFLINE_HBM_GBS:.0f})",
+                    "fuse the chunk hot path (ROADMAP item 3)"))
+    coll_ms = sum(r["phases"].get("stream.exchange", 0.0)
+                  + r["phases"].get("stream.materialize", 0.0)
+                  for r in per_query if r["ici"])
+    ici = sum(r["ici"] for r in per_query)
+    if coll_ms > 0 and ici > 0:
+        igbs = ici / (coll_ms / 1e3) / 1e9
+        frac = min(igbs / ROOFLINE_ICI_GBS, 1.0)
+        out.append((coll_ms * (1.0 - frac),
+                    f"ICI roofline headroom (collectives at {igbs:.1f} "
+                    f"GB/s = {frac * 100:.1f}% of {ROOFLINE_ICI_GBS:.0f})",
+                    "batch/widen exchanges (ROADMAP item 4)"))
+    return sorted(out, key=lambda t: t[0], reverse=True)
+
+
+def render(agg, source, top=10):
+    """The printable report from one collected aggregate."""
+    per_query = agg["per_query"]
+    sites = agg["sites"]
+    site_tag = agg["site_tag"]
+    fallbacks = agg["fallbacks"]
+    drive_ms, drive_n = agg["drive_ms"], agg["drive_n"]
+    mat_ms, mat_n = agg["mat_ms"], agg["mat_n"]
     used = [p for p in PHASES
             if any(r["phases"].get(p) for r in per_query.values())]
     if any(r["phases"].get("other") for r in per_query.values()):
         used.append("other")
     any_bytes = any(r["logical"] for r in per_query.values())
     any_ici = any(r["ici"] for r in per_query.values())
-    byte_heads = " logical MB | h2d MB | eff GB/s |" if any_bytes else ""
-    ici_heads = " ici MB | ici GB/s |" if any_ici else ""
-    n_cols = (len(used) + 3 + (3 if any_bytes else 0)
-              + (2 if any_ici else 0))
-    lines = [f"# trace report: {len(per_query)} queries from {trace_dir}",
+    byte_heads = (" logical MB | h2d MB | eff GB/s | %HBM roof |"
+                  if any_bytes else "")
+    ici_heads = " ici MB | ici GB/s | %ICI roof |" if any_ici else ""
+    n_cols = (len(used) + 3 + (4 if any_bytes else 0)
+              + (3 if any_ici else 0))
+    lines = [f"# trace report: {len(per_query)} queries from {source}",
              "",
              "| query | total ms | " + " | ".join(used) +
              " | host syncs |" + byte_heads + ici_heads,
@@ -180,7 +353,7 @@ def report(trace_dir, top=10):
             gbs = (r["logical"] / (r["stream_ms"] / 1e3) / 1e9) \
                 if r["stream_ms"] else 0.0
             tail = (f" {r['logical'] / 1e6:.1f} | {r['h2d'] / 1e6:.1f} | "
-                    f"{gbs:.2f} |")
+                    f"{gbs:.2f} | {gbs / ROOFLINE_HBM_GBS * 100:.1f} |")
         if any_ici:
             # effective ICI GB/s: the explicit collectives' wire bytes
             # over the collective phase wall (the exchange pass + the
@@ -188,7 +361,8 @@ def report(trace_dir, top=10):
             coll_ms = (r["phases"].get("stream.exchange", 0.0)
                        + r["phases"].get("stream.materialize", 0.0))
             igbs = (r["ici"] / (coll_ms / 1e3) / 1e9) if coll_ms else 0.0
-            tail += f" {r['ici'] / 1e6:.1f} | {igbs:.2f} |"
+            tail += (f" {r['ici'] / 1e6:.1f} | {igbs:.2f} | "
+                     f"{igbs / ROOFLINE_ICI_GBS * 100:.1f} |")
         lines.append(f"| {q} | {r['total_ms']:.1f} | {cells} | "
                      f"{r['syncs']} |" + tail)
     comp = sum(r["phases"].get("stream.compile", 0.0)
@@ -233,15 +407,44 @@ def report(trace_dir, top=10):
                          f"{fb['queries']:3d} scans  {reason}{extra}")
     else:
         lines.append("# no eager-fallback streamed scans in this run")
+    ranked = bottlenecks(agg)
+    lines.append("")
+    if ranked:
+        lines.append("# next bottleneck (ranked by attributable ms)")
+        for ms, what, action in ranked:
+            lines.append(f"  {ms:9.1f} ms  {what} -> {action}")
+    else:
+        lines.append("# next bottleneck: no attributable costs in "
+                     "this run")
     return lines
+
+
+def report(source, top=10):
+    """Aggregate a --trace-dir (directory) or a campaign evidence ledger
+    (file); returns the printable lines."""
+    if os.path.isdir(source):
+        agg = collect_from_traces(source)
+        if agg is None:
+            return [f"# no *.trace.json files under {source}"]
+    elif not os.path.exists(source):
+        return [f"# {source}: no such trace dir or ledger file"]
+    else:
+        agg = collect_from_ledger(source)
+        if agg is None:
+            return [f"# no completed query records in ledger {source}"]
+    return render(agg, source, top=top)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="aggregate a --trace-dir into the per-phase "
-        "breakdown table (PERF.md), top sync sites and fallback costs")
+        description="aggregate a --trace-dir (or a campaign evidence "
+        "ledger file) into the per-phase breakdown table (PERF.md), "
+        "roofline columns, top sync sites, fallback costs and the "
+        "ranked next-bottleneck summary")
     ap.add_argument("trace_dir", help="directory of *.trace.json files "
-                    "written by nds_power.py --trace-dir")
+                    "written by nds_power.py --trace-dir, OR a campaign "
+                    "evidence ledger file (bench.py resume JSONL / "
+                    "nds_power.py --ledger)")
     ap.add_argument("--top", type=int, default=10,
                     help="sync sites to list (default 10)")
     args = ap.parse_args(argv)
