@@ -59,6 +59,17 @@ with ``# nds-lint: ignore[rule]`` on the flagged line or the line above):
   ``chunk-loop-host-sync``. Error severity: the sharded streamed
   pipeline's collective budget proves these bodies sync-free, so a
   violation is a correctness bug, not a perf note.
+* ``host-read-in-pallas`` — a host-sync primitive, an
+  ``ops.host_read``-charging call, or an ``obs.span(...)`` trace context
+  inside a function passed to ``pl.pallas_call``. A Pallas kernel body
+  is compiled to Mosaic and runs per grid cell ON the device: a host
+  read there is not merely slow, it cannot exist (tracer error at best),
+  and a span would clock the kernel trace. Resolution mirrors
+  ``host-sync-in-shard-map``: any function whose name is passed as the
+  first argument to a ``pallas_call`` in the module, one level down into
+  module-local helpers. Error severity — the fused chunk-scan/probe
+  kernels (``engine/kernels.py``) are priced at ZERO host syncs by the
+  exec-audit sync model, so a violation is a correctness bug.
 * ``chunk-loop-host-sync`` — a host-sync primitive (``.item()``,
   ``np.asarray``/``np.array``, ``device_get``, ``.to_int()``, or the
   engine's ``host_sync``/``count_int``/``resolve_counts``) lexically
@@ -180,6 +191,26 @@ def _collect_shard_bodies(tree) -> set:
     return bodies
 
 
+def _collect_pallas_bodies(tree) -> set:
+    """Names of functions passed as the first argument to a
+    ``pallas_call`` anywhere in the module (``pl.pallas_call(kernel,
+    ...)`` / bare ``pallas_call``) — the kernel bodies the
+    ``host-read-in-pallas`` rule polices. Name-based resolution like
+    ``_collect_shard_bodies``: the conventional pattern defines the
+    body and wraps it in the same scope."""
+    bodies = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name == "pallas_call" and node.args and \
+                isinstance(node.args[0], ast.Name):
+            bodies.add(node.args[0].id)
+    return bodies
+
+
 def _is_jit_decorator(dec) -> tuple[bool, set]:
     """(is jax.jit, static arg positions/names) for one decorator node."""
     static: set = set()
@@ -210,11 +241,14 @@ def _is_jit_decorator(dec) -> tuple[bool, set]:
 class _Lint(ast.NodeVisitor):
     def __init__(self, path: str, rel: str, source: str,
                  sync_helpers: dict | None = None,
-                 shard_bodies: set | None = None):
+                 shard_bodies: set | None = None,
+                 pallas_bodies: set | None = None):
         self.rel = rel
         self.sync_helpers = sync_helpers or {}
         self.shard_bodies = shard_bodies or set()
         self.shard_depth = 0         # inside a shard_map/pjit body
+        self.pallas_bodies = pallas_bodies or set()
+        self.pallas_depth = 0        # inside a pallas_call kernel body
         self.lines = source.splitlines()
         self.findings: list = []
         self.scope_stack = ["<module>"]
@@ -298,6 +332,8 @@ class _Lint(ast.NodeVisitor):
         self.param_use_stack.append((names, {}))
         is_shard = node.name in self.shard_bodies
         self.shard_depth += is_shard
+        is_pallas = node.name in self.pallas_bodies
+        self.pallas_depth += is_pallas
         saved_loop = self.loop_depth
         saved_chunk = self.chunk_loop_depth
         self.loop_depth = 0
@@ -306,6 +342,7 @@ class _Lint(ast.NodeVisitor):
         self.loop_depth = saved_loop
         self.chunk_loop_depth = saved_chunk
         self.shard_depth -= is_shard
+        self.pallas_depth -= is_pallas
         self.jit_params.pop()
         if jit_static is not None:
             self.jit_depth -= 1
@@ -454,9 +491,55 @@ class _Lint(ast.NodeVisitor):
                        "shard_map/pjit body: one host sync per dispatch "
                        "hidden one level down", node.lineno)
 
+    def _check_pallas_sync(self, node) -> None:
+        """Flag host reads / spans inside a pallas_call kernel body: the
+        body compiles to a Mosaic program running per grid cell on the
+        device — host reads cannot exist there, spans would clock the
+        kernel trace."""
+        if not self.pallas_depth:
+            return
+        f = node.func
+        what = _sync_primitive(node)
+        if what is None:
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in _HOST_READ_FUNCS:
+                what = f"{f.attr}()"
+            elif isinstance(f, ast.Name) and f.id in _HOST_READ_FUNCS:
+                what = f"{f.id}()"
+        is_span = (isinstance(f, ast.Attribute) and f.attr == "span"
+                   and isinstance(f.value, ast.Name)
+                   and f.value.id in self.obs_aliases) or \
+            (isinstance(f, ast.Name) and f.id in self.span_funcs)
+        if what or is_span:
+            self._emit("host-read-in-pallas", "error",
+                       f"{what or 'obs.span(...)'} inside a pallas_call "
+                       "kernel body: the kernel is one Mosaic device "
+                       "program per grid cell — host reads cannot exist "
+                       "there and spans clock the kernel trace; compute "
+                       "on refs only and resolve on host outside the "
+                       "launch", node.lineno)
+            return
+        # one level down: a module-local helper whose body syncs directly
+        key = None
+        if isinstance(f, ast.Name):
+            key = (None, f.id)
+        elif isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and self.class_stack:
+            key = (self.class_stack[-1], f.attr)
+        hit = key is not None and self.sync_helpers.get(key)
+        if hit:
+            lineno, prim = hit
+            self._emit("host-read-in-pallas", "error",
+                       f"{key[1]}() (defined in this module, syncs via "
+                       f"{prim} at line {lineno}) called inside a "
+                       "pallas_call kernel body: a host sync hidden one "
+                       "level down", node.lineno)
+
     def visit_Call(self, node):
         self._check_chunk_loop_sync(node)
         self._check_shard_map_sync(node)
+        self._check_pallas_sync(node)
         f = node.func
         if isinstance(f, ast.Attribute):
             owner = f.value.id if isinstance(f.value, ast.Name) else None
@@ -689,7 +772,7 @@ def lint_file(path: str, rel: str | None = None) -> list:
         return [Finding(rel, "<module>", "syntax-error", "error",
                         str(e), e.lineno or 0)]
     lint = _Lint(path, rel, source, _collect_sync_helpers(tree),
-                 _collect_shard_bodies(tree))
+                 _collect_shard_bodies(tree), _collect_pallas_bodies(tree))
     lint.visit(tree)
     lint.finish()
     return lint.findings

@@ -1421,21 +1421,36 @@ def _probe_candidates(left_keys, right_keys, null_safe=False,
     lviews, rviews = _hash_views(left_keys, right_keys)
     lvalids = tuple(c.valid for c in left_keys)
     rvalids = tuple(c.valid for c in right_keys)
-    lh = _key_hash_impl(lviews, lvalids, 0, null_safe, count_arr(n_left),
-                        l_excl)
     rh = _key_hash_impl(rviews, rvalids, 1, null_safe, count_arr(n_right),
                         r_excl)
     order = jnp.argsort(rh)
     rh_sorted = jnp.take(rh, order)
-    lo = jnp.searchsorted(rh_sorted, lh, side="left")
-    hi = jnp.searchsorted(rh_sorted, lh, side="right")
-    counts = hi - lo
     if stream_bounds_on():
         # chunk-invariant program: no data-dependent sizing sync. The
         # caller sizes its pair bucket from static bounds and registers a
         # device-side overflow flag (checked at the pipeline's single
-        # materializing sync).
-        return counts, lo, order, None
+        # materializing sync). The probe side may take the fused Pallas
+        # bound-bucket probe (one VMEM pass: bitwise _key_hash_impl +
+        # both searchsorted sides against the resident dimension hash
+        # table) — candidate counts are identical by construction, so
+        # the XLA arm below stays the always-available fallback.
+        if not null_safe:
+            from nds_tpu.engine.kernels import try_fused_probe
+            got = try_fused_probe(left_keys, lviews, lvalids,
+                                  count_arr(n_left), l_excl, rh_sorted)
+            if got is not None:
+                counts, lo = got
+                return counts, lo, order, None
+        lh = _key_hash_impl(lviews, lvalids, 0, null_safe,
+                            count_arr(n_left), l_excl)
+        lo = jnp.searchsorted(rh_sorted, lh, side="left")
+        hi = jnp.searchsorted(rh_sorted, lh, side="right")
+        return hi - lo, lo, order, None
+    lh = _key_hash_impl(lviews, lvalids, 0, null_safe, count_arr(n_left),
+                        l_excl)
+    lo = jnp.searchsorted(rh_sorted, lh, side="left")
+    hi = jnp.searchsorted(rh_sorted, lh, side="right")
+    counts = hi - lo
     total = host_sync(jnp.sum(counts))                 # host sync 1
     return counts, lo, order, total
 

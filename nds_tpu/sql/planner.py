@@ -1293,8 +1293,10 @@ class Planner:
                 record_stream_event(parts[keep].alias, n_chunks,
                                     E.sync_count() - syncs0, "eager", reason,
                                     bytes_h2d=h2d)
+                from nds_tpu.engine.kernels import active_arm
                 _obs.annotate(path="eager", chunks=n_chunks, reason=reason,
-                              bytesH2d=h2d)
+                              bytesH2d=h2d, kernelArm=active_arm(),
+                              kernelLaunches=0, kernelStages=0)
             return result
 
     def _append_outer_extras(self, result, builds, bitmaps):

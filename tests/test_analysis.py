@@ -1487,3 +1487,219 @@ def test_lint_cli_update_baseline_refuses_foreign_corpus(tmp_path):
     assert json.load(open(report))["all"]
     r = _run_lint("--templates", str(seeded), "--baseline", alt)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# fused Pallas chunk kernels: lint rule + static prediction + lockstep
+# ---------------------------------------------------------------------------
+
+
+def test_jax_lint_host_read_in_pallas(tmp_path):
+    """Both directions of the host-read-in-pallas rule: host reads,
+    engine sync entry points, a one-level-down syncing helper and an
+    obs.span inside a pallas_call kernel body are errors; the same
+    calls outside any kernel body (or a clean body) are not."""
+    fs = lint_snippet(tmp_path, """
+        import jax
+        from jax.experimental import pallas as pl
+        from nds_tpu.engine import ops
+        from nds_tpu.obs import trace as obs
+
+        def _helper(x):
+            return ops.count_int(x.nrows)
+
+        def make(x):
+            def kernel(in_ref, out_ref):
+                with obs.span("inner"):
+                    pass
+                ops.host_read("tag", lambda: 1)
+                in_ref.to_int()
+                _helper(in_ref)
+                out_ref[:] = in_ref[:]
+            return pl.pallas_call(kernel, out_shape=None)(x)
+    """, rel="nds_tpu/engine/other.py")
+    rules = [f.rule for f in fs]
+    assert rules == ["host-read-in-pallas"] * 4, fs
+    assert all(f.severity == "error" for f in fs)
+    # clean kernel body + syncs OUTSIDE the body: no findings (the rule
+    # must not leak past the pallas_call'd function)
+    fs = lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+        from nds_tpu.engine import ops
+
+        def make(x):
+            def kernel(in_ref, out_ref):
+                out_ref[:] = in_ref[:] * 2
+            got = pl.pallas_call(kernel, out_shape=None)(x)
+            n = ops.count_int(4)          # outside: legal
+            return got, n
+    """, rel="nds_tpu/engine/other.py")
+    assert not [f for f in fs if f.rule == "host-read-in-pallas"], fs
+
+
+def test_jax_lint_pallas_rule_baseline_untouched():
+    """The shipped kernel bodies (engine/kernels.py) must be clean under
+    the new rule — the baseline gains nothing."""
+    from nds_tpu.analysis.jax_lint import lint_file
+    path = os.path.join(REPO, "nds_tpu", "engine", "kernels.py")
+    fs = lint_file(path, "nds_tpu/engine/kernels.py")
+    assert not [f for f in fs if f.rule == "host-read-in-pallas"], fs
+
+
+def test_kernel_spec_eligibility_rule():
+    """The shared eligibility rule (analysis/kernel_spec.py) on its
+    canonical shapes — the ONE rule the runtime lowering and the static
+    kernel prediction both consume."""
+    from nds_tpu.analysis.kernel_spec import (count_eligible,
+                                              eligible_conjunct)
+    from nds_tpu.sql.parser import parse
+
+    def conjs(sql):
+        q = parse(f"select 1 from t where {sql}")
+        w = q.body.where
+        out = []
+
+        def split(e):
+            import nds_tpu.sql.ast as A
+            if isinstance(e, A.BinaryOp) and e.op == "and":
+                split(e.left)
+                split(e.right)
+            else:
+                out.append(e)
+        split(w)
+        return out
+
+    classes = {"a": "num", "d": "date", "s": "str", "b": "bool"}
+
+    def class_of(ref):
+        return classes.get(ref.name.lower())
+
+    cs = conjs("a > 5 and 5 < a and a = 2.5 and s = 'x' and s > 'x' "
+               "and a in (1, 2, 3) and a between 1 and 9 "
+               "and s is not null and b = 1 and a > s")
+    want = [True, True, True, True, False,
+            True, True, True, False, False]
+    got = [eligible_conjunct(c, class_of) for c in cs]
+    assert got == want, list(zip(got, want, cs))
+    assert count_eligible(cs, class_of) == sum(want)
+    # the IN-list cap is part of the rule (kernel code size bound)
+    big = conjs(f"a in ({', '.join(str(i) for i in range(17))})")
+    assert not eligible_conjunct(big[0], class_of)
+
+
+def test_kernel_spec_threshold_math():
+    """Exact rational -> stored-space threshold mapping (the encoded-
+    space evaluation): boundaries, non-integral equalities, FOR rebase
+    and sorted-dict bisect."""
+    from fractions import Fraction
+
+    from nds_tpu.analysis.kernel_spec import (dict_map, shift_for,
+                                              value_cmp)
+    F = Fraction
+    assert value_cmp("<", F(11, 2)) == ("ile", 5)    # v < 5.5 -> v <= 5
+    assert value_cmp("<=", F(11, 2)) == ("ile", 5)
+    assert value_cmp(">", F(11, 2)) == ("ige", 6)
+    assert value_cmp(">=", F(11, 2)) == ("ige", 6)
+    assert value_cmp("<", F(5)) == ("ile", 4)        # v < 5 -> v <= 4
+    assert value_cmp("=", F(11, 2)) == ("false",)
+    assert value_cmp("<>", F(11, 2)) == ("true",)
+    assert value_cmp("=", F(7)) == ("ieq", 7)
+    assert shift_for(("ile", 100), 40) == ("ile", 60)
+    assert shift_for(("irange", 10, 20), 5) == ("irange", 5, 15)
+    vals = [10, 20, 30]
+    assert dict_map(("ieq", 20), vals) == ("ieq", 1)
+    assert dict_map(("ieq", 25), vals) == ("false",)
+    assert dict_map(("ile", 25), vals) == ("ile", 1)
+    assert dict_map(("ige", 25), vals) == ("ige", 2)
+    assert dict_map(("irange", 15, 30), vals) == ("irange", 1, 2)
+
+
+def test_exec_audit_kernel_prediction():
+    """The static kernel budget: exact scan/stage predictions from the
+    shared eligibility rule under an explicit NDS_TPU_PALLAS mode, and
+    all-zero under auto/off (the auditor cannot see the backend)."""
+    from nds_tpu.analysis.exec_audit import ExecAuditor
+    sql = ("select ss_item_sk from store_sales "
+           "where ss_quantity > 5 and ss_item_sk in (1, 2)")
+    old = os.environ.get("NDS_TPU_PALLAS")
+    try:
+        os.environ["NDS_TPU_PALLAS"] = "interpret"
+        rep = ExecAuditor(streamed={"store_sales"}).audit_sql(sql)
+        (scan,) = [s for s in rep.scans if s.compiled]
+        assert scan.kernel_scan_chunk == 1
+        assert scan.kernel_stages == 2          # two eligible conjuncts
+        os.environ["NDS_TPU_PALLAS"] = "off"
+        rep2 = ExecAuditor(streamed={"store_sales"}).audit_sql(sql)
+        (scan2,) = [s for s in rep2.scans if s.compiled]
+        assert (scan2.kernel_scan_chunk, scan2.kernel_stages,
+                scan2.kernel_probe_chunk) == (0, 0, 0)
+    finally:
+        if old is None:
+            os.environ.pop("NDS_TPU_PALLAS", None)
+        else:
+            os.environ["NDS_TPU_PALLAS"] = old
+
+
+def test_exec_audit_kernel_differential():
+    """The fused-kernel half of the lockstep contract: drained
+    StreamEvent kernel evidence (NDS_TPU_PALLAS=interpret sweep) must
+    match the static kernel predictions — stage counts exactly, launch
+    totals inside the scan-floor/probe-ceiling window, stream.kernel
+    spans sync-free — and the zeroed-prediction drift fixture must
+    fail."""
+    import importlib.util
+    path = os.path.join(REPO, "tools", "exec_audit_diff.py")
+    spec = importlib.util.spec_from_file_location("exec_audit_diff3", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    kern_ev = mod.collect_kernel_evidence()
+    ab = mod._load_ab_module()
+    with ab._forced_stream_partitions():
+        with ab._forced_pallas("interpret"):
+            reports = mod.predict(ab._STREAM_AB_QUERIES)
+    ok, lines = mod.compare_kernels(reports, kern_ev)
+    assert ok, "\n".join(lines)
+    drift_ok, drift_lines = mod.compare_kernels(reports, kern_ev,
+                                                inject_drift=True)
+    assert not drift_ok, "kernel drift fixture failed to fail"
+    assert any("kernel model drift" in ln or "static window" in ln
+               for ln in drift_lines)
+
+
+def test_mem_audit_kernel_differential():
+    """Kernel-arm soundness: the fused scan/probe kernels reuse the SAME
+    proof-sized accumulators, so every survivor/partition bound holds on
+    the Pallas arm, the subset really engages the kernels, and zeroed
+    bounds must fail."""
+    import importlib.util
+    path = os.path.join(REPO, "tools", "mem_audit_diff.py")
+    spec = importlib.util.spec_from_file_location("mem_audit_diff3", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    kern_ev, bounds, idxs = mod.collect_kernel_evidence()
+    assert kern_ev and idxs
+    ab = mod._load_ab_module()
+    reports = mod.predict(ab._STREAM_AB_QUERIES, bounds)
+    subset = [reports[i] for i in idxs]
+    ok, lines = mod.compare_kernels(subset, kern_ev)
+    assert ok, "\n".join(lines)
+    drift_ok, drift_lines = mod.compare_kernels(subset, kern_ev,
+                                                inject_drift=True)
+    assert not drift_ok, "kernel-arm drift fixture failed to fail"
+    assert any("UNSOUND" in ln for ln in drift_lines)
+
+
+def test_lint_changed_covers_kernels():
+    """tools/lint.py --changed: an edit to engine/kernels.py must rerun
+    the corpus passes (the kernel prediction lives in exec_audit and the
+    shared rule in analysis/kernel_spec.py — all under _CORPUS_ROOTS)."""
+    import importlib.util
+    path = os.path.join(REPO, "tools", "lint.py")
+    spec = importlib.util.spec_from_file_location("lint_tool_k", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    for p in ("nds_tpu/engine/kernels.py",
+              "nds_tpu/analysis/kernel_spec.py"):
+        assert p.startswith(mod._CORPUS_ROOTS), \
+            f"{p} not covered by _CORPUS_ROOTS"

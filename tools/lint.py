@@ -96,8 +96,15 @@ def git_changed_files():
 # evidence ledger — the runtime evidence layer the differential
 # harnesses check the audits against; ledger/export edits rerun the
 # corpus passes so span-in-jit and friends stay enforced on them.
+# nds_tpu/engine/kernels.py holds the fused Pallas chunk-scan/probe
+# kernels whose launch/stage counts exec_audit predicts statically
+# (the shared eligibility rule lives in analysis/kernel_spec.py) —
+# kernel edits rerun the corpus passes. Named explicitly even though
+# the nds_tpu/engine prefix already covers it: the kernel-edit contract
+# is load-bearing for the lockstep gate, not an accident of prefixing.
 _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
-                 "nds_tpu/engine", "nds_tpu/schema.py",
+                 "nds_tpu/engine", "nds_tpu/engine/kernels.py",
+                 "nds_tpu/schema.py",
                  "nds_tpu/listener.py", "nds_tpu/io/columnar.py",
                  "nds_tpu/parallel/", "nds_tpu/obs/")
 
