@@ -22,8 +22,16 @@ with no device in the loop:
 * :mod:`nds_tpu.analysis.driver_audit` — driver-level hygiene for the
   top-level CLIs and ``tools/``: swallowed exceptions, shell-injection
   surfaces, file handles opened outside context managers.
+* :mod:`nds_tpu.analysis.conc_audit` — shared-state/lock-discipline
+  audit over the whole package: inventories every module/class-level
+  mutable object, classifies each mutation site (lock-guarded /
+  thread-local / bounded-ring / atomic-rebind / unguarded), enforces
+  the no-sync-no-compile-under-lock and lock-order rules, and checks
+  cache-key completeness (every env knob reachable from a cached
+  computation appears in its key). Runtime half:
+  ``tools/conc_audit_diff.py``'s threaded stress differential.
 
-``tools/lint.py`` runs all five and gates on new findings against the
+``tools/lint.py`` runs all six and gates on new findings against the
 checked-in :data:`BASELINE_PATH` (accepted pre-existing findings); code-lint
 findings are suppressible in-source with ``# nds-lint: ignore[rule]``.
 """

@@ -12,6 +12,7 @@ once per distinct dictionary value on host, then map through the device codes
 from __future__ import annotations
 
 import re
+import threading
 
 import jax.numpy as jnp
 import numpy as np
@@ -20,7 +21,12 @@ from nds_tpu.engine.column import Column, encs_equal, is_dec
 from nds_tpu.engine.ops import ordered_codes_merged, plain_col
 
 _MAX_DEC_SCALE = 10
+# dictionary memos (literal dictionaries + per-tag _map_dict caches):
+# concurrent Throughput streams evaluate expressions at once, and
+# identity-keyed caches downstream need ONE winner per key — mutations
+# take the dedicated lock, setdefault keeps the first insert.
 _str_literal_dicts: dict = {}
+_DICT_MEMO_LOCK = threading.Lock()
 
 
 # ---------------------------------------------------------------------------
@@ -44,9 +50,11 @@ def literal(value, n: int) -> Column:
         # Bounded FIFO like the engine's other dictionary caches.
         d = _str_literal_dicts.get(value)
         if d is None:
-            if len(_str_literal_dicts) >= 4096:
-                _str_literal_dicts.pop(next(iter(_str_literal_dicts)))
-            d = _str_literal_dicts[value] = np.asarray([value], dtype=object)
+            built = np.asarray([value], dtype=object)
+            with _DICT_MEMO_LOCK:
+                if len(_str_literal_dicts) >= 4096:
+                    _str_literal_dicts.pop(next(iter(_str_literal_dicts)))
+                d = _str_literal_dicts.setdefault(value, built)
         return Column("str", jnp.zeros(n, dtype=jnp.int32), None, d)
     if type(value).__name__ == "Decimal":
         s = -value.as_tuple().exponent
@@ -483,9 +491,11 @@ def _map_dict(col: Column, fn, tag=None) -> Column:
         remap, uniq = compute()
     else:
         from nds_tpu.engine.ops import _identity_cache
-        remap, uniq = _identity_cache(
-            _map_dict_cache.setdefault(tag, {}), 256,
-            (col.dict_values,), compute)
+        sub = _map_dict_cache.get(tag)
+        if sub is None:
+            with _DICT_MEMO_LOCK:
+                sub = _map_dict_cache.setdefault(tag, {})
+        remap, uniq = _identity_cache(sub, 256, (col.dict_values,), compute)
     return Column("str", jnp.take(jnp.asarray(remap), col.data),
                   col.valid, uniq)
 

@@ -135,14 +135,18 @@ _pallas_broken = False
 # the one-hot matmul does O(rows x groups) MACs — MXU throughput makes that
 # a win over scatter only while the group tile count stays small. Measured
 # on v5e (n=16M): 1.8x faster at 1k groups, 12x SLOWER at 64k groups.
-_MAX_GROUPS = int(os.environ.get("NDS_TPU_PALLAS_MAX_GROUPS", "2048"))
+# Read at USE time (not import): the ceiling picks which segment
+# implementation TRACES, so it is a pipeline-cache key member
+# (engine/stream.py _cache_key) and a post-import change must retrace.
+def max_groups() -> int:
+    return int(os.environ.get("NDS_TPU_PALLAS_MAX_GROUPS", "2048"))
 
 
 def pallas_active(num_segments: int | None = None) -> bool:
     """True when :func:`segment_sum_fused` will take the Pallas path for
     this group count. Callers must gate on this (not the raw env var) so the
     exact XLA path is used whenever the kernel itself would fall back."""
-    if num_segments is not None and num_segments > _MAX_GROUPS:
+    if num_segments is not None and num_segments > max_groups():
         return False
     return not _pallas_broken and _pallas_mode() != "off"
 
@@ -151,7 +155,7 @@ def segment_sum_fused(weights, gids, num_segments: int):
     """(sums f32[G], counts f32[G]) of ``weights`` grouped by ``gids``.
 
     Rows with gid < 0 are excluded (pre-masked nulls / filtered rows).
-    Pallas MXU path on TPU (small group counts — see ``_MAX_GROUPS``), XLA
+    Pallas MXU path on TPU (small group counts — see ``max_groups()``), XLA
     segment ops elsewhere. Some TPU attachment paths (e.g. tunneled
     remote-compile backends) cannot compile Mosaic kernels at all; the first
     such failure permanently flips to the XLA fallback for the process
@@ -160,7 +164,7 @@ def segment_sum_fused(weights, gids, num_segments: int):
     global _pallas_broken
     mode = _pallas_mode()
     if mode != "off" and not _pallas_broken and \
-            num_segments <= _MAX_GROUPS:
+            num_segments <= max_groups():
         try:
             return _segment_sum_pallas(gids, weights, num_segments,
                                        mode == "interpret")
@@ -270,9 +274,10 @@ def _segment_sum_exact_pallas(gids, values, num_segments: int,
 #  16M x 1024: pallas 187.8ms vs XLA  97.4ms   (XLA 1.93x)
 #  16M x 2048: pallas 352.4ms vs XLA 107.5ms   (XLA 3.28x)
 # the one-hot matmul does O(n*G) MACs while XLA's scatter is O(n), so the
-# exact kernel engages only below the measured n*G break-even
-_EXACT_ONEHOT_BUDGET = int(float(os.environ.get(
-    "NDS_TPU_EXACT_ONEHOT_BUDGET", "3e8")))
+# exact kernel engages only below the measured n*G break-even.
+# Read at USE time for the same reason as max_groups() above.
+def exact_onehot_budget() -> int:
+    return int(float(os.environ.get("NDS_TPU_EXACT_ONEHOT_BUDGET", "3e8")))
 
 
 def exact_sum_supported(num_segments: int, n_rows: int) -> bool:
@@ -281,7 +286,7 @@ def exact_sum_supported(num_segments: int, n_rows: int) -> bool:
     the O(n*G) one-hot work sits below the measured XLA-scatter
     break-even (table above)."""
     return (pallas_active(num_segments) and n_rows < (1 << 23)
-            and n_rows * max(num_segments, 1) <= _EXACT_ONEHOT_BUDGET)
+            and n_rows * max(num_segments, 1) <= exact_onehot_budget())
 
 
 def segment_sum_exact(values, gids, num_segments: int):
@@ -392,7 +397,7 @@ def segment_minmax_fused(values, gids, num_segments: int):
     global _pallas_broken
     mode = _pallas_mode()
     if mode != "off" and not _pallas_broken and \
-            num_segments <= _MAX_GROUPS:
+            num_segments <= max_groups():
         try:
             return _segment_minmax_pallas(gids, values, num_segments,
                                           mode == "interpret")

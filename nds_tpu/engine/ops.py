@@ -49,6 +49,10 @@ def _pow2_ceil(n: int) -> int:
     return 1 << max(int(n) - 1, 1).bit_length() if n > 2 else 2
 
 
+# deliberate import freeze: the bucket floor is a process-wide shape
+# contract (session.py refuses mid-process changes by construction), so
+# the conc-audit freeze rule is waived on the next line.
+# nds-lint: ignore[env-freeze]
 _MIN_BUCKET = _pow2_ceil(int(os.environ.get("NDS_TPU_MIN_BUCKET", "16")))
 
 
@@ -639,9 +643,11 @@ def compact_indices(mask: jnp.ndarray, n: int) -> jnp.ndarray:
 # lazy-compaction bucket ceiling: below it, carrying the un-shrunk bucket
 # is cheaper than a device->host round trip (the round trip dominates on a
 # tunneled chip and is a full-mesh barrier under GSPMD); above it, the
-# resolve-and-slice pays for itself in downstream sort width
-_LAZY_SHRINK_ROWS = int(os.environ.get("NDS_TPU_LAZY_SHRINK_ROWS",
-                                       str(1 << 20)))
+# resolve-and-slice pays for itself in downstream sort width.
+# Read at USE time (not import) like stream_fanout(): setting
+# NDS_TPU_LAZY_SHRINK_ROWS after import must not be silently ignored.
+def lazy_shrink_rows() -> int:
+    return int(os.environ.get("NDS_TPU_LAZY_SHRINK_ROWS", str(1 << 20)))
 
 
 def compact_table(table: DeviceTable, mask: jnp.ndarray,
@@ -665,7 +671,7 @@ def compact_table(table: DeviceTable, mask: jnp.ndarray,
     idx = jnp.nonzero(m, size=cap, fill_value=max(table.plen, 1))[0]
     n = DeviceCount(jnp.sum(m), min(count_bound(table.nrows), cap))
     out = take_padded(table, idx, n)
-    if cap > _LAZY_SHRINK_ROWS and not stream_bounds_on():
+    if cap > lazy_shrink_rows() and not stream_bounds_on():
         # adaptive: past this bucket size the downstream sorts/segment ops a
         # fat bucket drags through cost more than one (batched) round trip,
         # so resolve now — the transfer still drains the whole pending batch
@@ -734,13 +740,24 @@ def take_padded(table: DeviceTable, idx: jnp.ndarray, nrows: int) -> DeviceTable
 # ---------------------------------------------------------------------------
 
 
+# ONE dedicated lock for every _identity_cache-managed dict (_rank_cache,
+# _merged_cache, _dense_dim_cache, _dim_span_cache, _union_cache, and
+# exprs.py's dictionary memos): all of their mutations funnel through
+# _identity_cache, so guarding the insert/evict here guards them all.
+# compute() stays OFF-lock — it may sync or trace, and the lock-discipline
+# audit (analysis/conc_audit.py) forbids either under a lock. Losing a
+# concurrent-insert race just recomputes one idempotent value.
+_IDENTITY_LOCK = threading.Lock()
+
+
 def _identity_cache(cache: dict, max_size: int, key_arrays: tuple, compute,
                     static_key=()):
     """Bounded FIFO cache keyed by the identity of host arrays (plus an
     optional hashable ``static_key`` for non-array parameters the cached
     value depends on). The entry holds references to the keyed arrays so a
     recycled id() can never alias a freed object; evicts oldest-first past
-    ``max_size``.
+    ``max_size``. Thread-safe: lock-free GIL-atomic read, mutations under
+    :data:`_IDENTITY_LOCK`.
 
     Under trace-replay the cache is BYPASSED: record and replay must
     consume the same host-read sequence, and a record-time cache hit
@@ -753,9 +770,17 @@ def _identity_cache(cache: dict, max_size: int, key_arrays: tuple, compute,
     if hit is not None and all(h is a for h, a in zip(hit[0], key_arrays)):
         return hit[1]
     value = compute()
-    if len(cache) >= max_size:
-        cache.pop(next(iter(cache)))
-    cache[key] = (key_arrays, value)
+    with _IDENTITY_LOCK:
+        # single winner per key: a concurrent miss that landed first
+        # keeps its entry and THIS caller adopts it — identity-keyed
+        # consumers downstream must see ONE host object per logical key
+        hit = cache.get(key)
+        if hit is not None and all(h is a
+                                   for h, a in zip(hit[0], key_arrays)):
+            return hit[1]
+        if len(cache) >= max_size:
+            cache.pop(next(iter(cache)))
+        cache[key] = (key_arrays, value)
     return value
 
 
@@ -939,7 +964,11 @@ def _group_ids_impl(views, valids, n_valid):
 # pack multi-key groupings into one sort key when the combined bit-width
 # fits: saves K sorts on the K+1-sort iterative fold. Only worth the extra
 # range-probe sync on big tables; small-table groupings are latency-bound.
-_PACK_MIN_PLEN = int(os.environ.get("NDS_TPU_GROUP_PACK_MIN", str(1 << 20)))
+# Read at USE time: the threshold feeds the traced per-chunk program, so
+# it is a pipeline-cache key member (engine/stream.py _cache_key) and an
+# import freeze would let a post-import change serve a stale pipeline.
+def group_pack_min() -> int:
+    return int(os.environ.get("NDS_TPU_GROUP_PACK_MIN", str(1 << 20)))
 
 
 @jax.jit
@@ -978,7 +1007,7 @@ def _group_ids_packed(views, valids, offsets, widths, n_valid):
 def _packed_group_plan(key_cols, views, n_valid):
     """(offsets, widths) when the combined key fits 62 bits, else None.
     String/bool key spans are host-known (dictionary sizes); integer keys
-    cost ONE fused range sync — only attempted past ``_PACK_MIN_PLEN``."""
+    cost ONE fused range sync — only attempted past ``group_pack_min()``."""
     int_idx = [i for i, c in enumerate(key_cols)
                if c.kind not in ("str", "bool")]
     spans = [None] * len(key_cols)
@@ -1048,7 +1077,7 @@ def group_ids(key_cols, n_valid: int | None = None):
     valids = tuple(c.valid for c in key_cols)
     nv = count_arr(n_valid)
     plan = None
-    if len(key_cols) > 1 and plen >= _PACK_MIN_PLEN:
+    if len(key_cols) > 1 and plen >= group_pack_min():
         plan = _packed_group_plan(key_cols, views, nv)
     if plan is not None:
         gids, ng_dev = _group_ids_packed(views, valids, plan[0], plan[1],
@@ -1485,7 +1514,7 @@ def join_indices(left_keys, right_keys, how: str = "inner",
             # streaming executor checks at its single materializing sync.
             total_dev = jnp.sum(counts)
             cand = min(bucket_len(count_bound(n_left)) * stream_fanout(),
-                       bucket_len(_PAIR_BUDGET))
+                       bucket_len(pair_budget()))
             stream_overflow(total_dev > cand)
             pair_live = jnp.arange(cand) < total_dev
             n_pairs_bound = cand
@@ -1836,8 +1865,11 @@ def _null_column_like(col: Column, n: int) -> Column:
 # inner join splits the probe side into capacity-bounded chunks (the >HBM
 # streaming answer SURVEY §5.7 calls for; the reference's analog is the
 # RAPIDS spill store + spark.sql.shuffle.partitions,
-# ref: nds/power_run_gpu.template:29-37)
-_PAIR_BUDGET = int(os.environ.get("NDS_TPU_PAIR_BUDGET", str(1 << 22)))
+# ref: nds/power_run_gpu.template:29-37). Read at USE time: the budget
+# sizes the stream-mode pair bucket inside the traced per-chunk program,
+# so it is a pipeline-cache key member (engine/stream.py _cache_key).
+def pair_budget() -> int:
+    return int(os.environ.get("NDS_TPU_PAIR_BUDGET", str(1 << 22)))
 
 # stream-bounds pair-bucket fanout: inside the compiled chunk pipeline a
 # hash join cannot sync for its candidate total, so the bucket is the
@@ -1890,13 +1922,13 @@ def _chunk_spans(counts_np, budget):
 def _chunked_inner_join(left, right, left_keys, right_keys, probe,
                         residual_fn) -> DeviceTable:
     """Inner join materialized span-by-span so peak memory is bounded by
-    ``_PAIR_BUDGET`` pairs, with residual predicates applied per span
+    ``pair_budget()`` pairs, with residual predicates applied per span
     before anything is kept — the pair expansion never exists whole."""
     counts, lo, order, total = probe
 
     def fetch():
         counts_np = np.asarray(counts)
-        return (_chunk_spans(counts_np, _PAIR_BUDGET),
+        return (_chunk_spans(counts_np, pair_budget()),
                 np.concatenate([[0], np.cumsum(counts_np)]))
 
     spans, cum = timed_read("chunk_spans", fetch)
@@ -1987,7 +2019,7 @@ def join_tables(left: DeviceTable, right: DeviceTable, left_on, right_on,
         # probe[3] is None under stream-bounds: the chunked (span-by-span)
         # join syncs per span, so the streamed path always takes the
         # bound-bucket monolithic arm below
-        if probe[3] is not None and probe[3] > _PAIR_BUDGET:
+        if probe[3] is not None and probe[3] > pair_budget():
             return _chunked_inner_join(left, right, left_keys, right_keys,
                                        probe, residual_fn)
     l_idx, r_idx, n_pairs, l_extra, n_lx, r_extra, n_rx = join_indices(

@@ -55,7 +55,11 @@ except ImportError:  # pragma: no cover - future jax relocations
 
 import os as _os
 
-_MAX_EQNS = int(_os.environ.get("NDS_TPU_REPLAY_MAX_EQNS", "4500"))
+# segmentation budget, read at USE time (not import): a post-import
+# change to the knob must shape the next replay build, not be silently
+# frozen (the conc-audit env-freeze rule).
+def _max_eqns() -> int:
+    return int(_os.environ.get("NDS_TPU_REPLAY_MAX_EQNS", "4500"))
 
 
 def _count_eqns(jaxpr) -> int:
@@ -80,7 +84,9 @@ def _eqn_weight(eq) -> int:
     return n
 
 
-_MAX_SEGMENTS = int(_os.environ.get("NDS_TPU_REPLAY_MAX_SEGMENTS", "6"))
+# read at USE time like _max_eqns() above
+def _max_segments() -> int:
+    return int(_os.environ.get("NDS_TPU_REPLAY_MAX_SEGMENTS", "6"))
 
 
 def _split_jaxpr(closed, max_eqns):
@@ -111,7 +117,7 @@ def _split_jaxpr(closed, max_eqns):
         cur_w += w
     if cur:
         groups.append(cur)
-    if len(groups) > _MAX_SEGMENTS:
+    if len(groups) > _max_segments():
         return None
     const_of = dict(zip(jaxpr.constvars, closed.consts))
     # var -> defining group index (inputs/consts = -1)
@@ -296,9 +302,9 @@ class CompiledQuery:
                 closed = jax.make_jaxpr(traced)(
                     self._flat_args(), self.operands)
         n_eqns = _count_eqns(closed.jaxpr)
-        if n_eqns > _MAX_EQNS:
+        if n_eqns > _max_eqns():
             self.jitted = None
-            split = _split_jaxpr(closed, _MAX_EQNS)
+            split = _split_jaxpr(closed, _max_eqns())
             if split is None:
                 raise _NotReplayable(
                     f"program too large to fuse profitably ({n_eqns} eqns) "

@@ -304,9 +304,34 @@ def _shard_plan(chunk_cap: int):
 # compiled pipelines are cached across statements (a Power Run executes
 # each query text 2-4 times); bounded FIFO, identity-validated on hit.
 # Mutations take the lock: concurrent Throughput streams share the cache.
+# A miss goes through the _PIPELINE_BUILDS singleflight registry
+# (key -> Event of the thread currently compiling that shape): waiters
+# block OFF-lock and take the winner's entry, so concurrent first sights
+# of one shape cost exactly ONE compile — and the compile itself never
+# runs under the lock (it would serialize every Throughput stream; the
+# conc-audit `compile-under-lock` rule rejects the pattern statically).
 _PIPELINE_CACHE: dict = {}
 _PIPELINE_MAX = 64
 _PIPELINE_LOCK = threading.Lock()
+_PIPELINE_BUILDS: dict = {}
+# per-shape successful-compile counts (guarded by _PIPELINE_LOCK): the
+# evidence tools/conc_audit_diff.py's exactly-one-compile check reads.
+_PIPELINE_BUILD_COUNTS: dict = {}
+
+
+def pipeline_build_counts() -> dict:
+    """Snapshot of per-shape compile counts since process start (or the
+    last :func:`reset_pipeline_cache`)."""
+    with _PIPELINE_LOCK:
+        return dict(_PIPELINE_BUILD_COUNTS)
+
+
+def reset_pipeline_cache() -> None:
+    """Drop the pipeline cache and the compile counters (test/harness
+    helper: a cold-cache differential needs a known-empty start)."""
+    with _PIPELINE_LOCK:
+        _PIPELINE_CACHE.clear()
+        _PIPELINE_BUILD_COUNTS.clear()
 
 
 class _NotStreamable(Exception):
@@ -1350,6 +1375,15 @@ def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
         # versa) — the spec itself derives from conjuncts + encodings,
         # both already key members
         _K.scan_kernels_active(), _K._pallas_mode(),
+        # read-at-use engine knobs reachable from the traced per-chunk
+        # program (cache-key completeness, enforced statically by
+        # analysis/conc_audit.py): pair-bucket budget and group-pack
+        # threshold shape the compiled join/group plan; the kernel
+        # eligibility budgets pick which segment implementation traces;
+        # lazy-shrink is stream-gated off but keyed anyway — the key is
+        # the ONE place a knob change is allowed to surface.
+        E.pair_budget(), E.group_pack_min(), E.lazy_shrink_rows(),
+        _K.max_groups(), _K.exact_onehot_budget(),
     )
 
 
@@ -1389,6 +1423,26 @@ def _replan_residuals(planner, pipe):
     return infos
 
 
+def _resolve_residuals(planner, key, pipe):
+    """Per-EXECUTION residual replan for a validated cache hit:
+    ``(pipe, resid_infos)`` ready to run, or ``(None, ())`` on residual
+    shape drift (the stale entry is evicted under the lock — the caller
+    rebuilds). Replan failures PROPAGATE: a device OOM or planner bug
+    while re-planning a subquery residual must never be mistaken for an
+    unkeyable statement. Shared by the fast path and the singleflight
+    waiters."""
+    if not pipe.residuals:
+        return pipe, ()
+    got = _replan_residuals(planner, pipe)
+    if got is None:
+        with _PIPELINE_LOCK:
+            if _PIPELINE_CACHE.get(key) is pipe:
+                _PIPELINE_CACHE.pop(key, None)
+                _PIPELINE_BUILD_COUNTS.pop(key, None)
+        return None, ()
+    return pipe, got
+
+
 def _cache_hit(key, chunk_spec, part_infos):
     pipe = _PIPELINE_CACHE.get(key)
     if pipe is None:
@@ -1411,6 +1465,7 @@ def _cache_hit(key, chunk_spec, part_infos):
         with _PIPELINE_LOCK:
             if _PIPELINE_CACHE.get(key) is pipe:
                 _PIPELINE_CACHE.pop(key, None)
+                _PIPELINE_BUILD_COUNTS.pop(key, None)
         return None
     return pipe
 
@@ -1468,42 +1523,77 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
     n_chunks = chunked.num_chunks()
 
     key = None
+    hit0 = None
+    pipe, resid_infos = None, ()
     try:
         key = _cache_key(alias, keep, join_preds, where_conjuncts,
                          masked_sources, part_infos, chunk_spec, chunk_cap,
                          chunked.nrows, outer_meta)
-        pipe = _cache_hit(key, chunk_spec, part_infos)
+        hit0 = _cache_hit(key, chunk_spec, part_infos)
     except Exception:
-        pipe = None                      # unkeyable statement: no cache
+        hit0 = None                      # unkeyable statement: no cache
+    # residual replan runs OUTSIDE the unkeyable guard: its failures are
+    # real execution errors, not cache-key problems
+    if hit0 is not None:
+        pipe, resid_infos = _resolve_residuals(planner, key, hit0)
     parts_flat = tuple(tuple(flat) for (_spec, flat) in part_infos)
-    resid_infos = ()
-    if pipe is not None and pipe.residuals:
-        # subquery residuals are per-EXECUTION operands: re-plan them (the
-        # inner queries stream through their own pipelines) and validate
-        # their shapes against the cached program
-        got = _replan_residuals(planner, pipe)
-        if got is None:
+
+    claim = None
+    if pipe is None and key is not None:
+        # singleflight: claim the compile for this shape or wait (off-
+        # lock) for the thread already compiling it, then take its
+        # entry. A waiter whose post-wait lookup misses again (the
+        # winner's entry was FIFO-evicted or went stale) LOOPS back to
+        # claim rather than building unclaimed — exactly one compile
+        # per shape holds even under churn. A build that REFUSES (not
+        # chunk-invariant) is deliberately not negative-cached: the
+        # refusal can depend on chunk DATA the key cannot see, so each
+        # waiter retries in turn — a serialized retry of a trace that
+        # fails during GIL-bound planner replay, which the pre-
+        # singleflight "parallel" attempts serialized anyway.
+        while pipe is None and claim is None:
             with _PIPELINE_LOCK:
-                if _PIPELINE_CACHE.get(key) is pipe:
-                    _PIPELINE_CACHE.pop(key, None)
-            pipe = None
-        else:
-            resid_infos = got
+                in_cache = key in _PIPELINE_CACHE
+                pending = None if in_cache else _PIPELINE_BUILDS.get(key)
+                if not in_cache and pending is None:
+                    claim = _PIPELINE_BUILDS[key] = threading.Event()
+                    break
+            if in_cache:
+                hit = _cache_hit(key, chunk_spec, part_infos)
+                if hit is not None:
+                    pipe, resid_infos = _resolve_residuals(
+                        planner, key, hit)
+                # stale entry evicted: next iteration claims or waits
+            else:
+                pending.wait(timeout=300.0)
     # label the planner's enclosing "stream" span with the cache outcome
     _obs.annotate(pipelineCache="hit" if pipe is not None else "miss")
 
     if pipe is None:
-        pipe, resid_infos = _build_pipeline(
-            planner, parts, keep, alias, join_preds, where_conjuncts,
-            masked_sources, part_infos, outer_meta, first, chunk_spec,
-            chunk_cap, n_chunks)
+        try:
+            pipe, resid_infos = _build_pipeline(
+                planner, parts, keep, alias, join_preds, where_conjuncts,
+                masked_sources, part_infos, outer_meta, first, chunk_spec,
+                chunk_cap, n_chunks)
+            if pipe is not None and key is not None:
+                with _PIPELINE_LOCK:
+                    _PIPELINE_BUILD_COUNTS[key] = \
+                        _PIPELINE_BUILD_COUNTS.get(key, 0) + 1
+                    while len(_PIPELINE_CACHE) >= _PIPELINE_MAX:
+                        evicted = next(iter(_PIPELINE_CACHE))
+                        _PIPELINE_CACHE.pop(evicted)
+                        # the counter follows its entry out: a long-
+                        # lived serving process must not grow one
+                        # counter key per shape it ever saw
+                        _PIPELINE_BUILD_COUNTS.pop(evicted, None)
+                    _PIPELINE_CACHE[key] = pipe
+        finally:
+            if claim is not None:
+                with _PIPELINE_LOCK:
+                    _PIPELINE_BUILDS.pop(key, None)
+                claim.set()
         if pipe is None:
             return None, "not chunk-invariant"
-        if key is not None:
-            with _PIPELINE_LOCK:
-                while len(_PIPELINE_CACHE) >= _PIPELINE_MAX:
-                    _PIPELINE_CACHE.pop(next(iter(_PIPELINE_CACHE)))
-                _PIPELINE_CACHE[key] = pipe
 
     resid_flat = tuple(tuple(flat) for (_spec, flat) in resid_infos)
     snapshot = list(E._pending_counts())
@@ -1530,6 +1620,7 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
         _restore_counts(snapshot, checks_snapshot)
         with _PIPELINE_LOCK:
             _PIPELINE_CACHE.pop(key, None)
+            _PIPELINE_BUILD_COUNTS.pop(key, None)
         if _strict() and not isinstance(exc, (E.StreamSyncError,
                                               E.ReplayMismatch)):
             raise

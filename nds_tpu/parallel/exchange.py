@@ -227,7 +227,12 @@ def stream_mesh_axis() -> str:
     return os.environ.get("NDS_TPU_STREAM_MESH_AXIS", "shard")
 
 
+# mesh cache: concurrent Throughput streams building sharded pipelines
+# share it, so mutations take the dedicated lock (double-checked insert —
+# the Mesh constructor is pure host object construction, legal under the
+# lock; no host read or jit compile ever runs here)
 _STREAM_MESHES: dict = {}
+_MESH_LOCK = threading.Lock()
 
 
 def stream_mesh(n_shards: int, axis: str | None = None) -> Mesh | None:
@@ -245,7 +250,11 @@ def stream_mesh(n_shards: int, axis: str | None = None) -> Mesh | None:
         devs = jax.local_devices()
         if len(devs) < n_shards:
             return None
-        m = _STREAM_MESHES[key] = Mesh(np.asarray(devs[:n_shards]), (axis,))
+        with _MESH_LOCK:
+            m = _STREAM_MESHES.get(key)
+            if m is None:
+                m = _STREAM_MESHES[key] = Mesh(np.asarray(devs[:n_shards]),
+                                               (axis,))
     return m
 
 
@@ -350,7 +359,12 @@ def _exchange_join_step(mesh, cap_in: int, pair_cap: int, axis: str):
     return jax.jit(sharded)
 
 
+# jitted exchange-step cache: building the jax.jit WRAPPER is lazy and
+# cheap (the underlying compile happens at first dispatch, off-lock);
+# setdefault-under-lock keeps one winner per key so concurrent streams
+# dispatch the same wrapper and XLA compiles each shape exactly once
 _exchange_step_cache: dict = {}
+_EXCHANGE_STEP_LOCK = threading.Lock()
 
 
 def exchange_join_pairs(lh, lrow, rh, rrow, mesh, axis: str = "part"):
@@ -370,8 +384,9 @@ def exchange_join_pairs(lh, lrow, rh, rrow, mesh, axis: str = "part"):
         key = (id(mesh), cap_in, pair_cap, axis)
         step = _exchange_step_cache.get(key)
         if step is None:
-            step = _exchange_step_cache[key] = _exchange_join_step(
-                mesh, cap_in, pair_cap, axis)
+            built = _exchange_join_step(mesh, cap_in, pair_cap, axis)
+            with _EXCHANGE_STEP_LOCK:
+                step = _exchange_step_cache.setdefault(key, built)
         l_idx, r_idx, live, overs = step(lh, lrow, rh, rrow)
         from nds_tpu.engine.ops import timed_read
         lo, ro, po = timed_read(

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from dataclasses import dataclass, field
 
 import jax
@@ -38,9 +39,13 @@ class ExecError(ValueError):
 
 # Defer pushed-down filters into the join hash (no compaction sync) up to
 # this physical size; above it, compaction pays for itself by shrinking the
-# join's sort/probe width.
-_DEFER_FILTER_MAX_ROWS = int(
-    os.environ.get("NDS_TPU_DEFER_FILTER_MAX_ROWS", 1 << 21))
+# join's sort/probe width. Read at USE time (not import) so tests and
+# Throughput children that set the knob after import are honored; its
+# effect needs no cache-key member — the routing's RESULT (part physical
+# lengths) is already a pipeline/fusion key component.
+def _defer_filter_max_rows() -> int:
+    return int(os.environ.get("NDS_TPU_DEFER_FILTER_MAX_ROWS", 1 << 21))
+
 
 # fused predicate programs: (conjunct expr keys, table signature) ->
 # (dictionary identity refs, jitted callable | None-for-fallback)
@@ -49,6 +54,58 @@ _MASK_FUSE_MAX = 4096
 # projection/aggregate-argument twin of the mask-fusion cache:
 # key -> (input dict identities, jitted fn | None, output (kind, dict) meta)
 _EXPR_FUSE_CACHE: dict = {}
+# ONE dedicated lock for both fusion caches (they share _fused_run, whose
+# in-flight build registry below spans them): mutations and the
+# singleflight claim/landing take the lock; the jitted trace attempt runs
+# OFF-lock — a compile under the lock would serialize every concurrent
+# Throughput stream (the conc-audit `compile-under-lock` rule).
+_FUSE_LOCK = threading.Lock()
+# singleflight registry: (cache id, key) -> threading.Event of the thread
+# currently tracing that fused program. Waiters block off-lock, then take
+# the winner's cache entry — exactly ONE compile per shape, checked by
+# tools/conc_audit_diff.py and tests/test_concurrency.py.
+_FUSE_BUILDS: dict = {}
+# per-(cache id, key) count of jit trace attempts, for the lockstep
+# harness's exactly-one-compile assertion; guarded by _FUSE_LOCK.
+_FUSE_BUILD_COUNTS: dict = {}
+
+
+def fuse_build_count() -> int:
+    """Total fused-program trace attempts since process start (or the
+    last :func:`reset_fuse_caches`) — test/harness observability."""
+    with _FUSE_LOCK:
+        return sum(_FUSE_BUILD_COUNTS.values())
+
+
+def fuse_build_counts() -> dict:
+    """Per-shape fused-program trace-attempt counts (snapshot): the
+    evidence the exactly-one-compile checks read."""
+    with _FUSE_LOCK:
+        return dict(_FUSE_BUILD_COUNTS)
+
+
+def reset_fuse_caches() -> None:
+    """Drop both fusion caches and the build counters (test/harness
+    helper: a cold-cache differential needs a known-empty start)."""
+    with _FUSE_LOCK:
+        _MASK_FUSE_CACHE.clear()
+        _EXPR_FUSE_CACHE.clear()
+        _FUSE_BUILD_COUNTS.clear()
+
+
+def _fuse_claim(bkey):
+    """Block until this thread owns the in-flight build claim for
+    ``bkey`` (waiting, off-lock, for any other builder to land first) —
+    the rebuild path's entry into the singleflight, so a cache entry
+    that cannot serve one caller's dictionary identities never triggers
+    concurrent duplicate traces."""
+    while True:
+        with _FUSE_LOCK:
+            pending = _FUSE_BUILDS.get(bkey)
+            if pending is None:
+                claim = _FUSE_BUILDS[bkey] = threading.Event()
+                return claim
+        pending.wait(timeout=60.0)
 
 
 @dataclass
@@ -1086,7 +1143,15 @@ class Planner:
         metadata as a tracing side effect. Returns ``(output, meta)`` or
         None when the batch is unfusable/pinned (caller evaluates eager).
         Runtime errors (device OOM, wedged RPC) propagate — swallowing one
-        would silently pin a fusable set to eager forever."""
+        would silently pin a fusable set to eager forever.
+
+        Thread-safe (concurrent Throughput streams share both module
+        caches): reads are lock-free (GIL-atomic dict get + identity
+        validation), every mutation takes :data:`_FUSE_LOCK`, and a miss
+        goes through the :data:`_FUSE_BUILDS` singleflight so concurrent
+        first sights of one shape cost exactly ONE jitted trace — the
+        trace itself runs OFF-lock (a compile under the lock would
+        serialize every stream)."""
         refs = {r.name.lower()
                 for c in exprs for r in self._column_refs(c)}
         # inputs cover only the columns the expressions can reference —
@@ -1101,41 +1166,80 @@ class Planner:
                tuple((n, c.kind, int(c.data.shape[0]), c.valid is not None,
                       str(c.data.dtype), enc_key(c.enc))
                      for n, c in zip(names, cols)))
-        hit = cache.get(key)
-        if hit is not None and all(h is c.dict_values
-                                   for h, c in zip(hit[0], cols)) and \
-                all(encs_equal(h, c.enc)
-                    for h, c in zip(hit[3], cols)):
-            fn = hit[1]
-            if fn is None:
+        _PINNED = ("pinned",)            # entry says: permanently eager
+
+        def serve(hit):
+            """Run a cache entry against this table, or None when the
+            entry is absent / does not cover these dictionary identities
+            and encodings (the caller then rebuilds)."""
+            if hit is None or \
+                    not all(h is c.dict_values
+                            for h, c in zip(hit[0], cols)) or \
+                    not all(encs_equal(h, c.enc)
+                            for h, c in zip(hit[3], cols)):
                 return None
-            return fn(tuple(c.data for c in cols),
-                      tuple(c.valid for c in cols)), hit[2]
-        dict_refs = tuple(c.dict_values for c in cols)
-        encs = tuple(c.enc for c in cols)
-        kinds = tuple(c.kind for c in cols)
-        ev = Planner({}, base_tables=set())
-        meta: list = []
-        fn = jax.jit(build_impl(ev, names, kinds, dict_refs, encs, meta))
-        try:
-            out = fn(tuple(c.data for c in cols),
-                     tuple(c.valid for c in cols))
-        except (TypeError, ValueError, NotImplementedError,
-                jax.errors.TracerArrayConversionError,
-                jax.errors.ConcretizationTypeError,
-                jax.errors.TracerBoolConversionError) as e:
-            logging.getLogger(__name__).info(
-                "%s fusion fell back to eager: %s: %s",
-                what, type(e).__name__, e)
-            if len(cache) >= _MASK_FUSE_MAX:
-                cache.pop(next(iter(cache)))
-            cache[key] = (dict_refs, None, None, encs)
+            if hit[1] is None:
+                return _PINNED
+            return hit[1](tuple(c.data for c in cols),
+                          tuple(c.valid for c in cols)), hit[2]
+
+        got = serve(cache.get(key))
+        if got is _PINNED:
             return None
-        m = list(meta)
-        if len(cache) >= _MASK_FUSE_MAX:
-            cache.pop(next(iter(cache)))
-        cache[key] = (dict_refs, fn, m, encs)
-        return out, m
+        if got is not None:
+            return got
+        # miss (or an entry that cannot serve these dictionary
+        # identities): claim the build — waiting out any in-flight
+        # builder — then re-check under the claim; the winner's entry
+        # usually serves without a trace, and a build only ever runs
+        # CLAIMED, so concurrent duplicate compiles of one shape cannot
+        # happen
+        bkey = (id(cache), key)
+        claim = _fuse_claim(bkey)
+        try:
+            got = serve(cache.get(key))
+            if got is not None:
+                return None if got is _PINNED else got
+            dict_refs = tuple(c.dict_values for c in cols)
+            encs = tuple(c.enc for c in cols)
+            kinds = tuple(c.kind for c in cols)
+            ev = Planner({}, base_tables=set())
+            meta: list = []
+            fn = jax.jit(build_impl(ev, names, kinds, dict_refs, encs,
+                                    meta))
+            try:
+                out = fn(tuple(c.data for c in cols),
+                         tuple(c.valid for c in cols))
+            except (TypeError, ValueError, NotImplementedError,
+                    jax.errors.TracerArrayConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerBoolConversionError) as e:
+                logging.getLogger(__name__).info(
+                    "%s fusion fell back to eager: %s: %s",
+                    what, type(e).__name__, e)
+                self._fuse_insert(cache, key, bkey,
+                                  (dict_refs, None, None, encs))
+                return None
+            m = list(meta)
+            self._fuse_insert(cache, key, bkey, (dict_refs, fn, m, encs))
+            return out, m
+        finally:
+            with _FUSE_LOCK:
+                _FUSE_BUILDS.pop(bkey, None)
+            claim.set()
+
+    @staticmethod
+    def _fuse_insert(cache, key, bkey, entry) -> None:
+        """Land one fusion-cache entry (FIFO-evicting past the bound) and
+        charge the per-shape build counter — all under the fuse lock.
+        The evicted entry's counter leaves with it (bounded counters)."""
+        with _FUSE_LOCK:
+            if len(cache) >= _MASK_FUSE_MAX:
+                evicted = next(iter(cache))
+                cache.pop(evicted)
+                _FUSE_BUILD_COUNTS.pop((id(cache), evicted), None)
+            cache[key] = entry
+            _FUSE_BUILD_COUNTS[bkey] = _FUSE_BUILD_COUNTS.get(bkey, 0) + 1
 
     def _has_window(self, e) -> bool:
         found = False
@@ -1500,7 +1604,7 @@ class Planner:
         for i, (p, f) in enumerate(zip(parts, filters_per_part)):
             if not f:
                 masks.append(None)
-            elif p.plen > _DEFER_FILTER_MAX_ROWS:
+            elif p.plen > _defer_filter_max_rows():
                 tables[i] = self._filter_conjuncts(p, f)
                 masks.append(None)
             else:

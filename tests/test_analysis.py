@@ -1374,7 +1374,7 @@ def test_lint_cli_format_json(tmp_path):
     assert doc["version"] == 1
     assert set(doc["pass_counts"]) == {"plan-audit", "exec-audit",
                                        "mem-audit", "jax-lint",
-                                       "driver-audit"}
+                                       "driver-audit", "conc-audit"}
     entries = doc["findings"]
     assert entries == sorted(
         entries, key=lambda e: (e["rule"], e["file"], e["symbol"]))
@@ -1703,3 +1703,329 @@ def test_lint_changed_covers_kernels():
               "nds_tpu/analysis/kernel_spec.py"):
         assert p.startswith(mod._CORPUS_ROOTS), \
             f"{p} not covered by _CORPUS_ROOTS"
+
+
+# ---------------------------------------------------------------------------
+# concurrency audit: shared-state classification + lock discipline
+# ---------------------------------------------------------------------------
+
+
+def conc_audit_tree(tmp_path, files, registry=None, entry_points=None):
+    """Audit a throwaway package: ``files`` maps name -> source. Default
+    entry points make EVERY function a concurrent root (severity error),
+    matching how snippet rules are asserted."""
+    import shutil
+    from nds_tpu.analysis.conc_audit import audit_package
+    pkg = tmp_path / "pkg"
+    shutil.rmtree(pkg, ignore_errors=True)   # fresh tree per call
+    pkg.mkdir()
+    for name, code in files.items():
+        (pkg / name).write_text(textwrap.dedent(code))
+    return audit_package(str(pkg), repo=str(tmp_path),
+                         registry=registry if registry is not None else {},
+                         entry_points=entry_points or (("", ""),))
+
+
+def test_conc_audit_accepted_state_classes(tmp_path):
+    """Thread-local stores, bounded-ring appends, atomic latch rebinds,
+    lock-guarded (consistently) mutations and import-time construction
+    are the ACCEPTED classes — none may produce a finding."""
+    fs = conc_audit_tree(tmp_path, {"mod.py": """
+        import threading
+        from collections import deque
+
+        _CACHE: dict = {}
+        _LOCK = threading.Lock()
+        _tls = threading.local()
+        RING = deque(maxlen=10)
+        FLAG = False
+        IMPORT_BUILT = {}
+        IMPORT_BUILT["x"] = 1            # import scope: serialized
+
+        def guarded(k, v):
+            with _LOCK:
+                if len(_CACHE) >= 8:
+                    _CACHE.pop(next(iter(_CACHE)))
+                _CACHE[k] = v
+
+        def tls_write():
+            _tls.ring = []
+
+        def ring_write(x):
+            RING.append(x)
+
+        def latch():
+            global FLAG
+            FLAG = True
+    """})
+    assert not [f for f in fs if f.rule != "cache-unregistered"], fs
+
+
+def test_conc_audit_unguarded_and_rmw(tmp_path):
+    """A bare container mutation and an augmented (read-modify-write)
+    rebind of a module global are findings; severity is error because
+    the snippet entry points make everything concurrently reachable."""
+    fs = conc_audit_tree(tmp_path, {"mod.py": """
+        _STATE: dict = {}
+        COUNT = 0
+
+        def unguarded(k, v):
+            _STATE[k] = v
+
+        def rmw():
+            global COUNT
+            COUNT += 1
+    """})
+    rules = sorted(f.rule for f in fs)
+    assert rules == ["unguarded-mutation", "unguarded-mutation"], fs
+    assert all(f.severity == "error" for f in fs)
+
+
+def test_conc_audit_mixed_guard(tmp_path):
+    """State mutated under its lock at one site and off-lock at another:
+    the off-lock site is flagged (the lock protects nothing)."""
+    fs = conc_audit_tree(tmp_path, {"mod.py": """
+        import threading
+        _CACHE: dict = {}
+        _LOCK = threading.Lock()
+
+        def guarded(k, v):
+            with _LOCK:
+                _CACHE[k] = v
+
+        def sneaky(k, v):
+            _CACHE[k] = v
+    """})
+    assert [f.rule for f in fs if f.rule == "mixed-guard"], fs
+    hit = next(f for f in fs if f.rule == "mixed-guard")
+    assert hit.query == "sneaky"
+
+
+def test_conc_audit_sync_compile_wait_under_lock(tmp_path):
+    """host_read-family calls, jax.jit compiles and blocking waits held
+    under a lock are errors — directly and one level down into a
+    module-local helper."""
+    fs = conc_audit_tree(tmp_path, {"mod.py": """
+        import threading
+        import jax
+        from nds_tpu.engine import ops
+        _LOCK = threading.Lock()
+
+        def _helper(x):
+            return ops.count_int(x)
+
+        def bad(x, f, ev):
+            with _LOCK:
+                n = x.item()
+                g = jax.jit(f)
+                ev.wait()
+                m = _helper(x)
+            return n, g, m
+
+        def good(x, f):
+            n = x.item()                 # off-lock: fine
+            g = jax.jit(f)
+            with _LOCK:
+                pass
+            return n, g
+    """})
+    rules = sorted(f.rule for f in fs)
+    assert rules == ["compile-under-lock", "sync-under-lock",
+                     "sync-under-lock", "wait-under-lock"], fs
+    assert all(f.query == "bad" for f in fs)
+
+
+def test_conc_audit_lock_order_cycle(tmp_path):
+    """Opposite-order nested acquisition across functions is a deadlock
+    finding; one consistent global order is clean."""
+    fs = conc_audit_tree(tmp_path, {"mod.py": """
+        import threading
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def ab():
+            with _A:
+                with _B:
+                    pass
+
+        def ba():
+            with _B:
+                with _A:
+                    pass
+    """})
+    assert [f for f in fs if f.rule == "lock-order-cycle"], fs
+    fs = conc_audit_tree(tmp_path, {"mod2.py": """
+        import threading
+        _A = threading.Lock()
+        _B = threading.Lock()
+
+        def ab():
+            with _A:
+                with _B:
+                    pass
+
+        def ab2():
+            with _A:
+                with _B:
+                    pass
+    """})
+    assert not [f for f in fs if f.rule == "lock-order-cycle"], fs
+
+
+def test_conc_audit_param_alias(tmp_path):
+    """A module cache passed as a plain parameter: mutations inside the
+    callee count against the module global with the CALLEE's guard —
+    guarded helper clean, unguarded helper flagged (the _identity_cache
+    pattern)."""
+    guarded = {"mod.py": """
+        import threading
+        _RANK_CACHE: dict = {}
+        _LOCK = threading.Lock()
+
+        def memo(cache, key, value):
+            with _LOCK:
+                cache[key] = value
+
+        def use(key, value):
+            return memo(_RANK_CACHE, key, value)
+    """}
+    fs = conc_audit_tree(tmp_path, guarded)
+    assert not [f for f in fs
+                if f.rule in ("unguarded-mutation", "mixed-guard")], fs
+    bad = {"mod2.py": """
+        _RANK_CACHE: dict = {}
+
+        def memo(cache, key, value):
+            cache[key] = value
+
+        def use(key, value):
+            return memo(_RANK_CACHE, key, value)
+    """}
+    fs = conc_audit_tree(tmp_path, bad)
+    hits = [f for f in fs if f.rule == "unguarded-mutation"]
+    assert hits and "_RANK_CACHE" in hits[0].message, fs
+
+
+def test_conc_audit_cache_key_completeness(tmp_path):
+    """A registered cache whose value-builder reads an env knob the key
+    expression never sees is an error; adding the knob to the key (or
+    an explicit justified exemption) clears it."""
+    from nds_tpu.analysis.conc_audit import CacheSpec
+    missing = {"keyed.py": """
+        import os
+        import threading
+        _STEP_CACHE: dict = {}
+        _LOCK = threading.Lock()
+
+        def knob():
+            return int(os.environ.get("MY_KNOB", "4"))
+
+        def build(n):
+            return n * knob()
+
+        def make_key(n):
+            return (n,)
+
+        def lookup(n):
+            k = make_key(n)
+            got = _STEP_CACHE.get(k)
+            if got is None:
+                built = build(n)
+                with _LOCK:
+                    got = _STEP_CACHE.setdefault(k, built)
+            return got
+    """}
+    reg = {("pkg/keyed.py", "_STEP_CACHE"): CacheSpec(
+        key_fns=("make_key",), builder_fns=("build",),
+        modules=("pkg/keyed.py",))}
+    fs = conc_audit_tree(tmp_path, missing, registry=reg)
+    hits = [f for f in fs if f.rule == "cache-key-missing-knob"]
+    assert hits and "MY_KNOB" in hits[0].message, fs
+    # knob joins the key expression -> clean
+    complete = dict(missing)
+    complete["keyed.py"] = missing["keyed.py"].replace(
+        "return (n,)", "return (n, knob())")
+    fs = conc_audit_tree(tmp_path, complete, registry=reg)
+    assert not [f for f in fs if f.rule == "cache-key-missing-knob"], fs
+    # ... or an exemption WITH a justification
+    reg_ex = {("pkg/keyed.py", "_STEP_CACHE"): CacheSpec(
+        key_fns=("make_key",), builder_fns=("build",),
+        modules=("pkg/keyed.py",),
+        exempt={"MY_KNOB": "fixture: declared stale-safe"})}
+    fs = conc_audit_tree(tmp_path, missing, registry=reg_ex)
+    assert not [f for f in fs if f.rule == "cache-key-missing-knob"], fs
+
+
+def test_conc_audit_cache_unregistered(tmp_path):
+    """A keyed, query-path-written *_CACHE dict that no CACHE_REGISTRY
+    entry declares prompts registration (warning)."""
+    fs = conc_audit_tree(tmp_path, {"mod.py": """
+        import threading
+        _NEW_CACHE: dict = {}
+        _LOCK = threading.Lock()
+
+        def put(k, v):
+            with _LOCK:
+                _NEW_CACHE[k] = v
+    """})
+    assert [f for f in fs if f.rule == "cache-unregistered"], fs
+
+
+def test_conc_audit_env_freeze_and_suppression(tmp_path):
+    """A module-level os.environ snapshot is flagged; the documented
+    in-source suppression (the _MIN_BUCKET process contract) waives it."""
+    fs = conc_audit_tree(tmp_path, {"mod.py": """
+        import os
+        FROZEN = int(os.environ.get("SOME_KNOB", "1"))
+    """})
+    assert [f.rule for f in fs] == ["env-freeze"], fs
+    fs = conc_audit_tree(tmp_path, {"mod2.py": """
+        import os
+        # nds-lint: ignore[env-freeze]
+        FROZEN = int(os.environ.get("SOME_KNOB", "1"))
+    """})
+    assert not fs, fs
+
+
+def test_conc_audit_current_tree_clean():
+    """The shipped package must pass its own concurrency audit with ZERO
+    findings — the acceptance bar: no accepted unguarded-mutation
+    findings on the query path, every cache registered and key-complete,
+    the deliberate freezes suppressed in-source."""
+    from nds_tpu.analysis.conc_audit import audit_concurrency
+    fs = audit_concurrency()
+    assert not fs, "\n".join(str(f) for f in fs)
+
+
+def test_conc_audit_differential_harness():
+    """The runtime half of the concurrency contract, both directions:
+    the threaded stress differential (bit-for-bit rows, exactly-one-
+    compile-per-shape, zero cross-thread bleed, lock-liveness probes)
+    must pass on the clean tree, and no-op'ing EACH named lock must make
+    its probe fail — a gate that cannot fail proves nothing."""
+    import importlib.util
+    path = os.path.join(REPO, "tools", "conc_audit_diff.py")
+    spec = importlib.util.spec_from_file_location("conc_audit_diff", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    ok, lines = mod.run_diff()
+    assert ok, "\n".join(lines)
+    caught, drift_lines = mod.run_drift()
+    assert caught, "\n".join(drift_lines)
+    assert sum("ok drift" in ln for ln in drift_lines) == \
+        len(mod._named_locks())
+
+
+def test_lint_jobs_thread_pool_matches_sequential():
+    """--jobs N runs the six passes in a thread pool with identical
+    findings/counts — the analysis layer passing its own audit, live."""
+    import importlib.util
+    path = os.path.join(REPO, "tools", "lint.py")
+    spec = importlib.util.spec_from_file_location("lint_tool_j", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    f1, c1, _r1, _m1, _e1 = mod.run_passes(jobs=1)
+    f6, c6, _r6, _m6, _e6 = mod.run_passes(jobs=6)
+    assert c1 == c6
+    assert [str(f) for f in f1] == [str(f) for f in f6]
+    assert "conc-audit" in c1

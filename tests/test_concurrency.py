@@ -1,0 +1,198 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Concurrency contract tests: cache singleflight under real threads and
+the read-at-use env-knob discipline.
+
+The static side (``analysis/conc_audit.py``) proves the lock layout;
+these tests pin the runtime behavior the serving front depends on —
+concurrent streams sharing one engine compile each shape exactly once,
+never corrupt each other's results, and honor env knobs set after
+import (the PR 6 ``_ACC_ROWS``/``_STREAM_FANOUT`` regression pattern).
+The full threaded differential (all mechanisms + lock-liveness probes)
+is ``tools/conc_audit_diff.py``, exercised from ``test_analysis.py``.
+"""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+
+from nds_tpu.engine.session import Session
+
+
+def _run_threads(n, fn):
+    """Barrier-started workers; returns (results-by-thread, errors)."""
+    barrier = threading.Barrier(n)
+    out: dict = {}
+    errors: list = []
+
+    def worker(t):
+        try:
+            barrier.wait(timeout=60)
+            out[t] = fn(t)
+        except Exception as e:            # pragma: no cover - diagnostics
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=900)
+    return out, errors
+
+
+def test_pipeline_cache_singleflight_two_threads():
+    """Two threads race the SAME chunked template from a cold cache:
+    the singleflight registry must hand both the one compiled pipeline
+    (per-shape build count exactly 1) and bit-identical rows."""
+    from test_synccount import (_chunked_star_session,
+                                _forced_stream_partitions,
+                                _STREAM_AB_QUERIES)
+
+    from nds_tpu.engine import stream
+
+    q = _STREAM_AB_QUERIES[2][0]          # grouped aggregate, compiled
+    with _forced_stream_partitions():
+        stream.reset_pipeline_cache()
+        s = _chunked_star_session(np.random.default_rng(3))
+        out, errors = _run_threads(2, lambda t: s.sql(q).collect())
+    assert not errors, errors
+    assert out[0] and out[0] == out[1]
+    counts = stream.pipeline_build_counts()
+    assert counts, "the template stopped streaming compiled"
+    assert all(n == 1 for n in counts.values()), counts
+
+
+def _plain_session():
+    s = Session()
+    s.create_temp_view("t", pa.table({
+        "k": pa.array(list(range(256)), pa.int64()),
+        "v": pa.array([i * 3 % 101 for i in range(256)], pa.int64()),
+    }), base=True)
+    return s
+
+
+def test_fusion_cache_singleflight_two_threads():
+    """Two threads race one fusable WHERE from cold fusion caches:
+    exactly one jitted trace per fused shape, identical rows."""
+    from nds_tpu.sql import planner
+
+    s = _plain_session()
+    q = "select k, v from t where k > 17 and v < 60 order by k"
+    want = s.sql(q).collect()             # warm the table path itself
+    planner.reset_fuse_caches()
+    out, errors = _run_threads(2, lambda t: s.sql(q).collect())
+    assert not errors, errors
+    assert out[0] == out[1] == want and want
+    counts = planner.fuse_build_counts()
+    assert counts, "the WHERE stopped going through expression fusion"
+    assert all(n == 1 for n in counts.values()), counts
+
+
+def test_fuse_cache_eviction_under_contention(monkeypatch):
+    """Concurrent distinct-shape churn past the FIFO bound: the cache
+    never exceeds its cap (evictions and inserts share the lock) and
+    every query still answers correctly."""
+    from nds_tpu.sql import planner
+
+    monkeypatch.setattr(planner, "_MASK_FUSE_MAX", 8)
+    s = _plain_session()
+    planner.reset_fuse_caches()
+
+    def churn(t):
+        rows = []
+        for i in range(12):
+            thr = t * 37 + i              # distinct per (thread, step)
+            got = s.sql(f"select k from t where k > {thr} and "
+                        f"v >= 0 order by k").collect()
+            rows.append((thr, len(got)))
+        return rows
+
+    out, errors = _run_threads(2, churn)
+    assert not errors, errors
+    for rows in out.values():
+        for thr, n in rows:
+            assert n == max(0, 256 - (thr + 1))
+    assert len(planner._MASK_FUSE_CACHE) <= 8
+
+
+def test_stream_mesh_cache_threaded_one_winner():
+    """Concurrent stream_mesh() calls for one (shards, axis) key must
+    return the SAME Mesh object (double-checked insert: one winner)."""
+    from nds_tpu.parallel import exchange
+
+    exchange._STREAM_MESHES.clear()
+    out, errors = _run_threads(
+        4, lambda t: exchange.stream_mesh(2, axis="conc_test_axis"))
+    assert not errors, errors
+    meshes = list(out.values())
+    assert meshes[0] is not None
+    assert all(m is meshes[0] for m in meshes)
+    assert len([k for k in exchange._STREAM_MESHES
+                if k[1] == "conc_test_axis"]) == 1
+
+
+def test_env_knobs_read_after_import(monkeypatch):
+    """Every converted import-time snapshot now reads its env knob at
+    build/use time — the set-after-import regression net (PR 6
+    pattern). A knob set after import must be honored immediately."""
+    from nds_tpu.engine import kernels, ops, replay
+    from nds_tpu.obs import trace
+    from nds_tpu.sql import planner
+
+    cases = [
+        ("NDS_TPU_PAIR_BUDGET", ops.pair_budget, "12345", 12345),
+        ("NDS_TPU_GROUP_PACK_MIN", ops.group_pack_min, "777", 777),
+        ("NDS_TPU_LAZY_SHRINK_ROWS", ops.lazy_shrink_rows, "4096", 4096),
+        ("NDS_TPU_PALLAS_MAX_GROUPS", kernels.max_groups, "99", 99),
+        ("NDS_TPU_EXACT_ONEHOT_BUDGET", kernels.exact_onehot_budget,
+         "1e6", 1_000_000),
+        ("NDS_TPU_REPLAY_MAX_EQNS", replay._max_eqns, "222", 222),
+        ("NDS_TPU_REPLAY_MAX_SEGMENTS", replay._max_segments, "9", 9),
+        ("NDS_TPU_DEFER_FILTER_MAX_ROWS", planner._defer_filter_max_rows,
+         "31337", 31337),
+        ("NDS_TPU_TRACE_RING", trace._ring_max, "123", 123),
+    ]
+    for env, accessor, raw, want in cases:
+        monkeypatch.setenv(env, raw)
+        assert accessor() == want, env
+        monkeypatch.delenv(env)
+    # the trace ring knob must reach a NEW thread's ring allocation
+    monkeypatch.setenv("NDS_TPU_TRACE_RING", "41")
+    got = {}
+
+    def attach_and_report():
+        trace.attach()
+        got["maxlen"] = trace._tls.ring.maxlen
+
+    t = threading.Thread(target=attach_and_report)
+    t.start()
+    t.join(timeout=30)
+    assert got.get("maxlen") == 41
+
+
+def test_engine_knobs_join_pipeline_cache_key(monkeypatch):
+    """The read-at-use knobs that shape the traced per-chunk program are
+    pipeline-cache key members: changing one after a compile must MISS
+    (fresh build), not serve the stale pipeline — cache-key completeness
+    at runtime, mirroring the static conc-audit rule."""
+    from test_synccount import (_chunked_star_session,
+                                _forced_stream_partitions,
+                                _STREAM_AB_QUERIES)
+
+    from nds_tpu.engine import stream
+
+    q = _STREAM_AB_QUERIES[1][0]
+    with _forced_stream_partitions():
+        stream.reset_pipeline_cache()
+        s = _chunked_star_session(np.random.default_rng(5))
+        rows1 = s.sql(q).collect()
+        n1 = sum(stream.pipeline_build_counts().values())
+        assert n1 >= 1
+        rows_warm = s.sql(q).collect()    # warm: cache hit, no build
+        assert sum(stream.pipeline_build_counts().values()) == n1
+        monkeypatch.setenv("NDS_TPU_PAIR_BUDGET", str(1 << 21))
+        rows2 = s.sql(q).collect()
+        n2 = sum(stream.pipeline_build_counts().values())
+        assert n2 > n1, "knob change served the stale compiled pipeline"
+    assert rows1 == rows_warm == rows2

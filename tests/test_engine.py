@@ -328,14 +328,14 @@ def test_chunked_join_matches_monolithic(monkeypatch):
 
     mono = E.join_tables(lt, rt, ["k"], ["j"])
     assert E.count_int(mono.nrows) > E._MIN_BUCKET          # pair expansion is real
-    monkeypatch.setattr(E, "_PAIR_BUDGET", 64)
+    monkeypatch.setenv("NDS_TPU_PAIR_BUDGET", "64")
     chunk = E.join_tables(lt, rt, ["k"], ["j"])
     assert rows(chunk) == rows(mono)
 
     # residual inside the join == filter applied after the join
     res = lambda t: t["a"].data < t["b"].data
     chunk_res = E.join_tables(lt, rt, ["k"], ["j"], residual_fn=res)
-    monkeypatch.setattr(E, "_PAIR_BUDGET", 1 << 22)
+    monkeypatch.setenv("NDS_TPU_PAIR_BUDGET", str(1 << 22))
     mono_res = E.join_tables(lt, rt, ["k"], ["j"], residual_fn=res)
     expect = [r for r in rows(mono) if r[1] < r[3]]
     assert rows(chunk_res) == sorted(expect)
@@ -347,7 +347,7 @@ def test_packed_grouping_matches_iterative(monkeypatch):
     exactly: mixed int/string/bool keys, nulls, negative values, and pad
     rows."""
     import jax.numpy as jnp
-    monkeypatch.setattr(E, "_PACK_MIN_PLEN", 1)      # force packing
+    monkeypatch.setenv("NDS_TPU_GROUP_PACK_MIN", "1")   # force packing
     rng = np.random.default_rng(17)
     n = 3000
     t = pa.table({
@@ -359,7 +359,7 @@ def test_packed_grouping_matches_iterative(monkeypatch):
     dt = from_arrow(t)
     cols = [dt["a"], dt["b"], dt["c"]]
     gids_p, ng_p, rep_p, cap_p = E.group_ids(cols, n_valid=n)
-    monkeypatch.setattr(E, "_PACK_MIN_PLEN", 1 << 60)  # force iterative
+    monkeypatch.setenv("NDS_TPU_GROUP_PACK_MIN", str(1 << 60))  # force iterative
     gids_i, ng_i, rep_i, cap_i = E.group_ids(cols, n_valid=n)
     assert ng_p == ng_i and cap_p == cap_i
     # group ids may be numbered differently; compare PARTITIONS: rows

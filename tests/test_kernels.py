@@ -96,7 +96,7 @@ def test_segment_minmax_fused_interpret(interpret_mode, n, groups):
 def test_segment_minmax_group_gate(monkeypatch):
     """Above the group-count gate the XLA path must be taken (and agree)."""
     monkeypatch.setenv("NDS_TPU_PALLAS", "interpret")
-    monkeypatch.setattr(kernels, "_MAX_GROUPS", 4)
+    monkeypatch.setenv("NDS_TPU_PALLAS_MAX_GROUPS", "4")
     gids = jnp.asarray(np.array([0, 1, 5, 5, 3], dtype=np.int32))
     vals = jnp.asarray(np.array([1.0, -2.0, 7.0, 3.0, 0.5], dtype=np.float32))
     mins, maxs = kernels.segment_minmax_fused(vals, gids, 6)
@@ -146,7 +146,7 @@ def test_segment_sum_exact_extremes(interpret_mode):
 
 
 def test_exact_gate_declines_out_of_bounds(interpret_mode):
-    assert not kernels.exact_sum_supported(kernels._MAX_GROUPS + 1, 100)
+    assert not kernels.exact_sum_supported(kernels.max_groups() + 1, 100)
     assert not kernels.exact_sum_supported(100, 1 << 23)     # too many rows
     assert kernels.exact_sum_supported(100, 100)
 

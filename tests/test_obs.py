@@ -186,14 +186,22 @@ def test_manager_unattributed_keeps_newest_1000():
     Manager.unattributed.clear()
 
 
-def test_span_ring_bounded():
-    obs_trace.drain_spans()
-    for i in range(obs_trace._RING_MAX + 50):
+def test_span_ring_bounded(monkeypatch):
+    # the capacity is read at ring-ATTACH time (the read-at-use knob
+    # contract), so pin the env and force a fresh ring for this thread —
+    # the live env can differ from whatever sized an earlier ring (e.g.
+    # tools/sync_profile.py raises the default at import)
+    monkeypatch.setenv("NDS_TPU_TRACE_RING", "96")
+    obs_trace._tls.ring = None
+    obs_trace.drain_spans()              # re-attaches at the pinned size
+    ring_max = 96
+    for i in range(ring_max + 50):
         with obs_trace.span("s", i=i):
             pass
     got = obs_trace.drain_spans()
-    assert len(got) == obs_trace._RING_MAX
-    assert got[-1].attrs["i"] == obs_trace._RING_MAX + 49  # newest kept
+    assert len(got) == ring_max
+    assert got[-1].attrs["i"] == ring_max + 49  # newest kept
+    obs_trace._tls.ring = None           # restore default-size ring
 
 
 # ---------------------------------------------------------------------------

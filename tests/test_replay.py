@@ -79,7 +79,9 @@ def test_replay_segmented_when_program_too_big(replay_session, monkeypatch):
     chain of bounded segment programs (compile stays ~linear) and replay
     with identical rows — the 'replay total' path the q14/q67-class
     megaqueries take instead of permanent eager fallback."""
-    monkeypatch.setattr("nds_tpu.engine.replay._MAX_EQNS", 150)
+    # the knob is read at USE time now, so the env var (not a module
+    # constant) is the thing to pin — the set-after-import contract
+    monkeypatch.setenv("NDS_TPU_REPLAY_MAX_EQNS", "150")
     s = replay_session
     r1 = s.sql(Q).collect()
     r2 = s.sql(Q).collect()          # record + compile (segmented)

@@ -437,7 +437,7 @@ def test_partitioned_pipeline_survives_adaptive_resolve(monkeypatch):
     divert every partitioned pipeline to the eager loop. Simulated by
     lowering the threshold below the toy chunk capacity."""
     from nds_tpu.engine import ops as E
-    monkeypatch.setattr(E, "_LAZY_SHRINK_ROWS", 256)
+    monkeypatch.setenv("NDS_TPU_LAZY_SHRINK_ROWS", "256")
     sales, returns = _return_tables()
     events = _run_partition_case(monkeypatch, sales, returns, 4,
                                  chunk_rows=800)    # chunk_cap 1024 > 256

@@ -1,0 +1,598 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Threaded stress differential: the runtime half of the concurrency
+contract.
+
+The concurrency auditor (``nds_tpu/analysis/conc_audit.py``) is a static
+MODEL of the engine's lock discipline; a model nobody exercises drifts.
+This harness drives the canonical ``tests/test_synccount.py`` A/B
+templates through the real engine from multiple threads sharing ONE
+session (the in-process Throughput shape, strict + forced partitions)
+and fails when the shared-state contract is violated:
+
+* **bit-for-bit equality** — every template's rows from the concurrent
+  run must equal the serial run's exactly. Thread scheduling must never
+  reach the math.
+* **exactly-one-compile-per-shape** — the per-shape pipeline compile
+  counters (``stream.pipeline_build_counts``) of the concurrent run must
+  equal the serial run's, every count 1: the singleflight registries
+  turned concurrent first sights into one compile, and no cross-thread
+  churn evicted/rebuilt a shape.
+* **zero cross-thread bleed** — StreamEvents and spans are thread-scoped
+  by contract: each worker must drain exactly the events its own
+  templates produced (same count and paths as the serial run), and the
+  MAIN thread must drain nothing after the workers finish.
+* **lock-liveness probes** — for each NAMED lock, the main thread holds
+  the lock while a worker drives the real mutation path that must
+  acquire it, then inspects the guarded structure while still holding:
+  any observed mutation means the path no longer honors the lock. This
+  is deterministic in BOTH directions (no timing-dependent race): with
+  the lock honored the worker blocks at acquisition, with the lock
+  removed (or no-op'd) the worker's mutation lands inside the hold
+  window.
+
+``--inject-drift`` monkeypatches each named lock (or ``--lock NAME``,
+one) to a no-op context manager and reruns the probes — every injection
+MUST be caught, proving the harness can detect a dropped or dead lock
+(``tests/test_analysis.py`` asserts both directions in tier-1). Run the
+harness after any change to the engine's caches, the singleflight
+registries, or the lock layout: the static auditor and this differential
+are kept in lockstep the same way exec/mem audit track the executor.
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+import threading
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# how long the probe holds each lock while watching for an intruding
+# mutation: long enough for a warmed worker to reach the acquisition
+# point, short enough to keep the clean run cheap
+_PROBE_HOLD_S = 1.5
+_N_THREADS = 4
+# the threaded sweeps drive a representative A/B subset (star join,
+# filter+projection, grouped aggregate, partitioned fan-out join,
+# outer-build, two-pipeline subquery chain) — every pipeline mechanism,
+# bounded wall clock: the full corpus already runs serially in the
+# exec/mem differentials, this harness prices CONTENTION
+_DIFF_TEMPLATES = (0, 1, 2, 7, 10, 11)
+
+
+_AB_MOD = None
+
+
+def _load_ab_module():
+    """The pinned A/B fixture module, executed ONCE per process: every
+    collector and probe shares the same templates/contexts (and the
+    module-level setup does not rerun per call)."""
+    global _AB_MOD
+    if _AB_MOD is None:
+        path = os.path.join(REPO, "tests", "test_synccount.py")
+        spec = importlib.util.spec_from_file_location(
+            "_synccount_fixtures", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _AB_MOD = mod
+    return _AB_MOD
+
+
+def _reset_engine_caches():
+    from nds_tpu.engine import stream
+    from nds_tpu.sql import planner
+    stream.reset_pipeline_cache()
+    planner.reset_fuse_caches()
+
+
+# ---------------------------------------------------------------------------
+# serial / concurrent sweeps
+# ---------------------------------------------------------------------------
+
+
+def collect_serial():
+    """One thread, every template in order on a cold engine: the truth
+    the concurrent run is differenced against. Returns (per-template
+    records, per-shape pipeline build counts)."""
+    import numpy as np
+
+    from nds_tpu.engine import stream
+    from nds_tpu.listener import drain_stream_events
+    from nds_tpu.obs import trace as obs_trace
+
+    mod = _load_ab_module()
+    with mod._forced_stream_partitions():
+        _reset_engine_caches()
+        session = mod._chunked_star_session(np.random.default_rng(42))
+        drain_stream_events()
+        obs_trace.drain_spans()
+        out = []
+        for i in _DIFF_TEMPLATES:
+            rows = session.sql(mod._STREAM_AB_QUERIES[i][0]).collect()
+            events = drain_stream_events()
+            spans = obs_trace.drain_spans()
+            out.append({"idx": i, "rows": rows,
+                        "paths": [e.path for e in events],
+                        "n_spans": len(spans)})
+        builds = stream.pipeline_build_counts()
+    return out, builds
+
+
+def collect_concurrent(n_threads=_N_THREADS):
+    """N threads, disjoint template subsets (round-robin), ONE shared
+    session, cold engine, barrier start. Returns (per-template records,
+    build counts, main-thread leftovers, worker errors)."""
+    import numpy as np
+
+    from nds_tpu.engine import stream
+    from nds_tpu.listener import drain_stream_events
+    from nds_tpu.obs import trace as obs_trace
+
+    mod = _load_ab_module()
+    queries = mod._STREAM_AB_QUERIES
+    with mod._forced_stream_partitions():
+        _reset_engine_caches()
+        session = mod._chunked_star_session(np.random.default_rng(42))
+        drain_stream_events()
+        obs_trace.drain_spans()
+        barrier = threading.Barrier(n_threads)
+        results: dict = {}
+        errors: list = []
+
+        def worker(idxs):
+            try:
+                barrier.wait(timeout=60)
+                for i in idxs:
+                    rows = session.sql(queries[i][0]).collect()
+                    events = drain_stream_events()
+                    spans = obs_trace.drain_spans()
+                    results[i] = {"idx": i, "rows": rows,
+                                  "paths": [e.path for e in events],
+                                  "n_spans": len(spans)}
+            except Exception:
+                errors.append(traceback.format_exc())
+
+        threads = [threading.Thread(
+            target=worker,
+            args=([i for j, i in enumerate(_DIFF_TEMPLATES)
+                   if j % n_threads == t],),
+            daemon=True, name=f"conc-diff-{t}")
+            for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1200)
+        leftovers = {"events": len(drain_stream_events()),
+                     "spans": len(obs_trace.drain_spans())}
+        builds = stream.pipeline_build_counts()
+    return results, builds, leftovers, errors
+
+
+def collect_same_template(n_threads=_N_THREADS, idx=1):
+    """All N threads race ONE template from a cold engine: the
+    singleflight convergence case — exactly one pipeline compile, one
+    fused-program trace per shape, every thread's rows identical."""
+    import numpy as np
+
+    from nds_tpu.engine import stream
+    from nds_tpu.listener import drain_stream_events
+    from nds_tpu.obs import trace as obs_trace
+    from nds_tpu.sql import planner
+
+    mod = _load_ab_module()
+    sql = mod._STREAM_AB_QUERIES[idx][0]
+    with mod._forced_stream_partitions():
+        _reset_engine_caches()
+        session = mod._chunked_star_session(np.random.default_rng(42))
+        drain_stream_events()
+        obs_trace.drain_spans()
+        barrier = threading.Barrier(n_threads)
+        rows_by_thread: dict = {}
+        errors: list = []
+
+        def worker(t):
+            try:
+                barrier.wait(timeout=60)
+                rows_by_thread[t] = session.sql(sql).collect()
+                drain_stream_events()
+                obs_trace.drain_spans()
+            except Exception:
+                errors.append(traceback.format_exc())
+
+        threads = [threading.Thread(target=worker, args=(t,),
+                                    daemon=True)
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1200)
+        builds = stream.pipeline_build_counts()
+        fuse_builds = planner.fuse_build_counts()
+    return rows_by_thread, builds, fuse_builds, errors
+
+
+def compare(serial, conc, conc_builds, serial_builds, leftovers,
+            errors):
+    ok = True
+    lines = []
+    if errors:
+        ok = False
+        lines.append("MISMATCH worker exceptions in the concurrent run:")
+        lines.extend(f"    {e.splitlines()[-1]}" for e in errors)
+    for rec in serial:
+        i = rec["idx"]
+        got = conc.get(i)
+        head = f"[ab{i + 1}]"
+        problems = []
+        if got is None:
+            problems.append("template never completed concurrently")
+        else:
+            if got["rows"] != rec["rows"]:
+                problems.append(
+                    f"concurrent rows differ from serial "
+                    f"({len(got['rows'])} vs {len(rec['rows'])} rows): "
+                    "thread scheduling reached the math")
+            if got["paths"] != rec["paths"]:
+                problems.append(
+                    f"concurrent StreamEvents {got['paths']} != serial "
+                    f"{rec['paths']}: events bled across threads or the "
+                    "path flipped under contention")
+            if rec["n_spans"] and not got["n_spans"]:
+                problems.append(
+                    "the executing thread drained no spans (its trace "
+                    "ring lost records to another thread)")
+        if problems:
+            ok = False
+            lines.append(f"MISMATCH {head}")
+            lines.extend(f"    {p}" for p in problems)
+    if conc_builds != serial_builds:
+        ok = False
+        lines.append(
+            f"MISMATCH pipeline compiles: concurrent {conc_builds} != "
+            f"serial {serial_builds} (cross-thread churn or a "
+            "duplicated compile)")
+    over = [k for k, n in conc_builds.items() if n != 1]
+    if over:
+        ok = False
+        lines.append(
+            f"MISMATCH exactly-one-compile: {len(over)} shapes compiled "
+            f"more than once: {[conc_builds[k] for k in over]}")
+    if leftovers["events"] or leftovers["spans"]:
+        ok = False
+        lines.append(
+            f"MISMATCH cross-thread bleed: the MAIN thread drained "
+            f"{leftovers['events']} StreamEvents / "
+            f"{leftovers['spans']} spans it never produced")
+    if ok:
+        lines.append(
+            f"ok threaded differential :: {len(serial)} templates over "
+            f"{_N_THREADS} threads, {sum(serial_builds.values())} "
+            "compiles (all exactly-once), zero bleed")
+    return ok, lines
+
+
+# ---------------------------------------------------------------------------
+# lock-liveness probes
+# ---------------------------------------------------------------------------
+
+
+class _NoopLock:
+    """The drift fixture: a context manager that guards nothing."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def acquire(self, *a, **kw):
+        return True
+
+    def release(self):
+        pass
+
+
+def _named_locks():
+    """name -> (module, lock attribute) of every contract lock the
+    probes exercise (and --inject-drift can no-op)."""
+    from nds_tpu.engine import exprs, ops, stream
+    from nds_tpu.parallel import exchange
+    from nds_tpu.sql import planner
+    return {
+        "pipeline": (stream, "_PIPELINE_LOCK"),
+        "fuse": (planner, "_FUSE_LOCK"),
+        "mesh": (exchange, "_MESH_LOCK"),
+        "identity": (ops, "_IDENTITY_LOCK"),
+        "exprs": (exprs, "_DICT_MEMO_LOCK"),
+    }
+
+
+def _probe_specs():
+    """name -> (observe, mutate) where ``observe()`` snapshots the
+    guarded structure (GIL-atomic size reads) and ``mutate()`` drives
+    the REAL public code path that must acquire the lock to land a new
+    entry. Each mutate uses a fresh key so the path cannot shortcut
+    through a cache hit."""
+    import numpy as np
+
+    from nds_tpu.engine import exprs, ops, stream
+    from nds_tpu.parallel import exchange
+    from nds_tpu.sql import planner
+
+    mod = _load_ab_module()
+
+    def fresh():
+        # process-global sequence: a repeated literal would hit the
+        # cache entry an earlier probe (or the warm-up query) landed
+        # and never reach the lock
+        _probe_seq["n"] += 1
+        return _probe_seq["n"]
+
+    # every mutate drives a FRESH shape (new literal -> new cache key),
+    # so an intruding mutation strictly GROWS the observed structures —
+    # a reset-then-rebuild probe could round-trip back to the same sizes
+    # and mask a dead lock. The singleflight claim registers BEFORE the
+    # compile, so the drift arm is detected within milliseconds even
+    # though the build itself takes seconds.
+    def pipeline_observe():
+        return (len(stream._PIPELINE_CACHE), len(stream._PIPELINE_BUILDS),
+                len(stream._PIPELINE_BUILD_COUNTS))
+
+    def pipeline_mutate():
+        with mod._forced_stream_partitions():
+            session = _probe_sessions["chunked"]
+            thr = 9000 + fresh()
+            session.sql(
+                "select ss_item_sk, ss_ext_sales_price from store_sales "
+                f"where ss_ext_sales_price > {thr} and ss_item_sk < 40 "
+                "order by ss_item_sk, ss_ext_sales_price").collect()
+
+    def fuse_observe():
+        return (len(planner._MASK_FUSE_CACHE),
+                len(planner._EXPR_FUSE_CACHE),
+                len(planner._FUSE_BUILDS),
+                len(planner._FUSE_BUILD_COUNTS))
+
+    def fuse_mutate():
+        session = _probe_sessions["plain"]
+        thr = fresh()
+        session.sql(f"select k, v from probe_t where k > {thr} and "
+                    "v < 90 order by k").collect()
+
+    def mesh_observe():
+        return len(exchange._STREAM_MESHES)
+
+    def mesh_mutate():
+        exchange.stream_mesh(1, axis=f"probe{fresh()}")
+
+    def identity_observe():
+        return len(ops._rank_cache)
+
+    def identity_mutate():
+        arr = np.asarray([f"p{fresh()}", f"q{fresh()}"], dtype=object)
+        ops._dict_ranks(arr)
+
+    def exprs_observe():
+        return len(exprs._str_literal_dicts)
+
+    def exprs_mutate():
+        exprs.literal(f"probe-value-{fresh()}", 4)
+
+    return {
+        "pipeline": (pipeline_observe, pipeline_mutate),
+        "fuse": (fuse_observe, fuse_mutate),
+        "mesh": (mesh_observe, mesh_mutate),
+        "identity": (identity_observe, identity_mutate),
+        "exprs": (exprs_observe, exprs_mutate),
+    }
+
+
+_probe_sessions: dict = {}
+_probe_seq = {"n": 100}   # literals start past every warm-up constant
+
+
+def _build_probe_sessions():
+    """Sessions (and one warm pass) for the probe mutation paths, built
+    BEFORE any lock is held so probe-time work is parse+plan only."""
+    import numpy as np
+    import pyarrow as pa
+
+    mod = _load_ab_module()
+    if "chunked" not in _probe_sessions:
+        with mod._forced_stream_partitions():
+            _probe_sessions["chunked"] = mod._chunked_star_session(
+                np.random.default_rng(7))
+            # warm: trace/compile once so the probe-time rerun (after a
+            # cache reset) reaches the lock acquisition quickly
+            _probe_sessions["chunked"].sql(
+                mod._STREAM_AB_QUERIES[1][0]).collect()
+    if "plain" not in _probe_sessions:
+        from nds_tpu.engine.session import Session
+        s = Session()
+        s.create_temp_view("probe_t", pa.table({
+            "k": pa.array(list(range(64)), pa.int64()),
+            "v": pa.array(list(range(0, 128, 2)), pa.int64()),
+        }), base=True)
+        s.sql("select k, v from probe_t where k > 1 and v < 90 "
+              "order by k").collect()
+        _probe_sessions["plain"] = s
+
+
+def probe_lock(name, lock, observe, mutate, hold_s=_PROBE_HOLD_S):
+    """Hold ``lock`` while a worker drives ``mutate()``; fail when the
+    guarded structure changes during the hold. Deterministic: an honored
+    lock blocks the worker at acquisition (no mutation can land), a
+    no-op'd or bypassed lock lets the warmed worker land one well inside
+    the hold window."""
+    done = threading.Event()
+    errors: list = []
+
+    def worker():
+        try:
+            mutate()
+        except Exception:
+            errors.append(traceback.format_exc())
+        done.set()
+
+    before = observe()
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"probe-{name}")
+    with lock:
+        t.start()
+        done.wait(timeout=hold_s)      # give the worker the full window
+        during = observe()
+    t.join(timeout=600)
+    problems = []
+    if errors:
+        problems.append(f"probe path raised: {errors[0].splitlines()[-1]}")
+    if during != before:
+        problems.append(
+            f"guarded structure mutated {before} -> {during} while the "
+            f"{name} lock was held: the mutation path no longer honors "
+            "the lock")
+    if t.is_alive():
+        # a worker still blocked long after the lock was released is the
+        # WORST regression (a deadlock) — it must fail, not pass
+        problems.append(
+            f"probe worker still blocked {600}s after the {name} lock "
+            "was released: deadlock in the mutation path")
+    elif not done.is_set():
+        problems.append("probe worker died without signaling")
+    return problems
+
+
+def run_probes(only=None, lines=None):
+    """Run the lock-liveness probes; returns (ok, lines)."""
+    lines = [] if lines is None else lines
+    _build_probe_sessions()
+    locks = _named_locks()
+    specs = _probe_specs()
+    ok = True
+    for name in sorted(specs):
+        if only is not None and name != only:
+            continue
+        module, attr = locks[name]
+        observe, mutate = specs[name]
+        problems = probe_lock(name, getattr(module, attr), observe,
+                              mutate)
+        if problems:
+            ok = False
+            lines.append(f"MISMATCH lock probe [{name}]")
+            lines.extend(f"    {p}" for p in problems)
+        else:
+            lines.append(f"ok lock probe [{name}] :: mutation blocked "
+                         "for the full hold window")
+    return ok, lines
+
+
+def run_drift(lock_name=None):
+    """No-op each named lock (or just ``lock_name``) and require its
+    probe to FAIL. Returns (all_caught, lines)."""
+    locks = _named_locks()
+    names = [lock_name] if lock_name else sorted(locks)
+    _build_probe_sessions()
+    all_caught = True
+    lines = []
+    for name in names:
+        module, attr = locks[name]
+        real = getattr(module, attr)
+        setattr(module, attr, _NoopLock())
+        try:
+            ok, _sub = run_probes(only=name)
+        finally:
+            setattr(module, attr, real)
+        if ok:
+            all_caught = False
+            lines.append(f"DRIFT NOT CAUGHT [{name}]: the probe passed "
+                         "with a no-op lock — the harness cannot detect "
+                         "a dropped lock")
+        else:
+            lines.append(f"ok drift [{name}] :: no-op lock correctly "
+                         "rejected")
+    return all_caught, lines
+
+
+def run_diff():
+    """Full harness: serial truth, concurrent differential, same-
+    template singleflight convergence, lock probes."""
+    serial, serial_builds = collect_serial()
+    conc, conc_builds, leftovers, errors = collect_concurrent()
+    ok, lines = compare(serial, conc, conc_builds, serial_builds,
+                        leftovers, errors)
+
+    rows_by_thread, builds, fuse_builds, st_errors = \
+        collect_same_template(idx=1)
+    want = next(r["rows"] for r in serial if r["idx"] == 1)
+    problems = []
+    if st_errors:
+        problems.append(f"worker raised: "
+                        f"{st_errors[0].splitlines()[-1]}")
+    if len(rows_by_thread) != _N_THREADS:
+        problems.append(f"only {len(rows_by_thread)}/{_N_THREADS} "
+                        "threads completed")
+    for t, rows in sorted(rows_by_thread.items()):
+        if rows != want:
+            problems.append(f"thread {t} rows differ from serial")
+    multi = {k: n for k, n in builds.items() if n != 1}
+    if multi:
+        problems.append(f"pipeline shapes compiled more than once under "
+                        f"the same-template race: {list(multi.values())}")
+    fmulti = {k: n for k, n in fuse_builds.items() if n != 1}
+    if fmulti:
+        problems.append(f"fused shapes traced more than once under the "
+                        f"same-template race: {list(fmulti.values())}")
+    if not builds:
+        problems.append("same-template race compiled nothing (the "
+                        "template stopped streaming?)")
+    if problems:
+        ok = False
+        lines.append("MISMATCH same-template singleflight")
+        lines.extend(f"    {p}" for p in problems)
+    else:
+        lines.append(
+            f"ok same-template singleflight :: {_N_THREADS} threads, "
+            f"{sum(builds.values())} pipeline compile(s), "
+            f"{sum(fuse_builds.values())} fused trace(s), identical rows")
+
+    ok_p, lines = run_probes(lines=lines)
+    return ok and ok_p, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="threaded stress differential: lock discipline and "
+        "cache singleflight under concurrent query streams")
+    ap.add_argument("--inject-drift", action="store_true",
+                    help="no-op each named lock in turn: every probe "
+                    "must FAIL (lock-drift self-test)")
+    ap.add_argument("--lock", default=None,
+                    help="with --inject-drift: no-op only this lock")
+    args = ap.parse_args(argv)
+    if args.inject_drift:
+        caught, lines = run_drift(args.lock)
+        for ln in lines:
+            print(ln)
+        if caught:
+            print("# drift fixtures correctly rejected (harness is live)")
+            return 0
+        print("# DRIFT FIXTURE FAILED TO FAIL: the harness cannot "
+              "detect a dropped lock")
+        return 1
+    ok, lines = run_diff()
+    for ln in lines:
+        print(ln)
+    if ok:
+        print("# conc-audit differential: lock discipline and cache "
+              "singleflight hold under threads")
+        return 0
+    print("# conc-audit differential FAILED: update the engine's lock "
+          "contract and nds_tpu/analysis/conc_audit.py in lockstep")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

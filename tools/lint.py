@@ -1,7 +1,7 @@
 # Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
-"""Static analysis gate: plan/exec/mem auditors + engine/driver lint.
+"""Static analysis gate: plan/exec/mem/conc auditors + engine/driver lint.
 
-Runs the five :mod:`nds_tpu.analysis` passes entirely on host (no device,
+Runs the six :mod:`nds_tpu.analysis` passes entirely on host (no device,
 no data) and exits nonzero when any finding is NOT covered by the
 checked-in baseline (``nds_tpu/analysis/baseline.json``) — the accepted
 pre-existing findings. New code must come in clean; accepting a new
@@ -19,6 +19,9 @@ Usage:
                                               # bounds (mem-audit)
     python tools/lint.py --changed            # lint only files in the
                                               # current git diff
+    python tools/lint.py --jobs 6             # run the passes in a thread
+                                              # pool (the analysis layer
+                                              # passes its own conc audit)
     python tools/lint.py --templates DIR      # audit a different corpus
     python tools/lint.py --update-baseline    # accept current findings
     python tools/lint.py --no-baseline        # print everything, exit 0/2
@@ -43,6 +46,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from nds_tpu.analysis import (BASELINE_PATH, diff_against_baseline,  # noqa: E402
                               load_baseline, write_baseline)
+from nds_tpu.analysis.conc_audit import audit_concurrency  # noqa: E402
 from nds_tpu.analysis.driver_audit import audit_drivers, driver_files  # noqa: E402
 from nds_tpu.analysis.exec_audit import (audit_exec_corpus,  # noqa: E402
                                          format_stream_report,
@@ -109,12 +113,17 @@ _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/parallel/", "nds_tpu/obs/")
 
 
-def run_passes(template_dir=None, changed=None, want_reports=False):
+def run_passes(template_dir=None, changed=None, want_reports=False,
+               jobs=1):
     """Run the analysis passes; ``changed`` (repo-relative paths) restricts
     the fast path to affected files only (edits under any _CORPUS_ROOTS
     prefix — schema.py, engine/, analysis/, sql/, queries/ — rerun the
-    corpus-level audits, mem-audit included). Returns (findings, pass
-    counts, exec reports, mem reports, elapsed seconds)."""
+    corpus-level audits, mem-audit included). ``jobs`` > 1 runs the
+    passes in a thread pool: each pass reads shared immutable inputs
+    (templates, sources) and appends only to its own lists, the exact
+    discipline the conc-audit pass itself enforces — findings stay in
+    the fixed pass order either way. Returns (findings, pass counts,
+    exec reports, mem reports, elapsed seconds)."""
     t0 = time.time()
     findings = []
     counts = {}
@@ -160,8 +169,19 @@ def run_passes(template_dir=None, changed=None, want_reports=False):
         passes.append(("mem-audit", run_mem))
     passes.append(("jax-lint", run_jax))
     passes.append(("driver-audit", run_drivers))
-    for name, fn in passes:
-        got = fn()
+    # the concurrency audit is a whole-package pass: any nds_tpu edit
+    # (not just corpus roots) can add shared state, so only a diff with
+    # NO package files skips it
+    if changed is None or any(c.startswith("nds_tpu/") for c in changed):
+        passes.append(("conc-audit", audit_concurrency))
+    if jobs > 1:
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            futures = [(name, pool.submit(fn)) for name, fn in passes]
+            results = [(name, fut.result()) for name, fut in futures]
+    else:
+        results = [(name, fn()) for name, fn in passes]
+    for name, got in results:
         counts[name] = len(got)
         findings.extend(got)
     return findings, counts, reports, mem_reports, time.time() - t0
@@ -208,6 +228,9 @@ def main(argv=None) -> int:
     ap.add_argument("--changed", action="store_true",
                     help="fast path: lint only files in the current git "
                     "diff (full run when not in a git checkout)")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="run the analysis passes in an N-thread pool "
+                    "(default 1: sequential); output order is identical")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: the checked-in one)")
     ap.add_argument("--update-baseline", action="store_true",
@@ -228,7 +251,8 @@ def main(argv=None) -> int:
 
     findings, counts, reports, mem_reports, elapsed = run_passes(
         args.templates, changed=changed,
-        want_reports=args.stream_report or args.mem_report)
+        want_reports=args.stream_report or args.mem_report,
+        jobs=max(args.jobs, 1))
 
     # diff against the PRE-update baseline so a --json report written
     # alongside --update-baseline shows what was just accepted

@@ -118,8 +118,8 @@ def main():
         sess.sql(sql).collect()
         used = E.sync_count() - s0
         records = obs_trace.drain_spans()
-        if len(records) >= obs_trace._RING_MAX:
-            print(f"  !! trace ring full ({obs_trace._RING_MAX} records): "
+        if len(records) >= obs_trace._ring_max():
+            print(f"  !! trace ring full ({obs_trace._ring_max()} records): "
                   "oldest sync sites evicted — histogram is a floor; "
                   "raise NDS_TPU_TRACE_RING", file=sys.stderr)
         sites = site_histogram(records)
