@@ -120,6 +120,14 @@ _HOST_READ_FUNCS = {"host_read", "timed_read", "guarded_scalar_read",
 ENTRY_POINTS = (
     ("engine/session.py", "sql"),            # Planner statement execution
     ("engine/stream.py", "stream_execute"),  # pipeline build/drive
+    # the bounded prefetch ring: its worker thread runs concurrently
+    # with the driver by construction. All ring state is INSTANCE-scoped
+    # (one queue + stop event per ring, never module-level), handed
+    # between exactly two threads through the queue's own lock —
+    # workers never touch the session caches — so the inventory below
+    # stays at zero findings; the runtime half is conc_audit_diff's
+    # ring-liveness probe.
+    ("engine/prefetch.py", ""),
     ("listener.py", "record_stream_event"),
     ("listener.py", "drain_stream_events"),
     ("listener.py", "report_task_failure"),
@@ -188,6 +196,14 @@ _PIPELINE_EXEMPT = {
     "shape contract (ops._MIN_BUCKET, suppressed env-freeze): "
     "mem_audit's live read equals the frozen value under the contract, "
     "so the key cannot go stale within one process",
+    "NDS_TPU_CHUNK_STORE": "source routing only: the persistent chunk "
+    "store's wire path produces bit-identical buffers (same codecs, "
+    "same lowering math, encodings already key members via enc_key), "
+    "so a store on/off flip can never stale a compiled pipeline",
+    "NDS_TPU_CHUNK_STORE_VERIFY": "load-time CRC toggle only: it "
+    "decides whether wire files are verified before the mmap, never "
+    "what the buffers contain — same bit-identical-buffers argument "
+    "as NDS_TPU_CHUNK_STORE",
 }
 
 CACHE_REGISTRY = {

@@ -100,6 +100,22 @@ default). The contract is checked the same way as every other zero: the
 A/B templates run encoded by default through both differential harnesses,
 whose static sync bounds would fail if encode/decode started paying.
 
+**The prefetch worker is sync-free.** The bounded prefetch ring
+(``engine/prefetch.py``, ``NDS_TPU_PREFETCH_DEPTH``) moves the host
+slice + narrow encode + async upload of upcoming chunks onto a worker
+thread while the driver dispatches compute. None of that work ever
+reads the device (numpy slicing plus an asynchronous ``device_put``),
+so the sync-effect model charges the ring NOTHING — no bound in this
+module changes with the ring on (the default) or at any depth, and
+``StreamEvent.syncs`` is identical between depth 0 and depth N (the
+slow-source differential in ``tests/test_prefetch.py`` pins it). The
+zero is enforced two ways: statically by the
+``host-sync-in-prefetch-worker`` jax_lint rule (a host read or span in
+any callable handed to the ring is an error — the worker's thread-local
+counters would swallow it), and at runtime by the same span/event sync
+cross-checks the differential harness already runs (a worker sync would
+surface as an event-vs-bound mismatch).
+
 **Trace instrumentation is sync-free.** The obs span layer
 (:mod:`nds_tpu.obs`) wraps the instrumented phases in host-clock spans
 that read only the thread's existing sync/wait/compile counters, so the

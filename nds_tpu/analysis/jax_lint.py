@@ -70,6 +70,22 @@ with ``# nds-lint: ignore[rule]`` on the flagged line or the line above):
   module-local helpers. Error severity — the fused chunk-scan/probe
   kernels (``engine/kernels.py``) are priced at ZERO host syncs by the
   exec-audit sync model, so a violation is a correctness bug.
+* ``host-sync-in-prefetch-worker`` — a host-sync primitive, an
+  ``ops.host_read``-charging call, or an ``obs.span(...)`` trace
+  context inside a callable handed to the bounded prefetch ring
+  (``engine/prefetch.py``: the ``prepare`` step of
+  ``chunk_ring``/``ChunkRing``, any named function passed to those
+  constructors, or the callee of a call expression passed as the
+  source iterator — the generator's per-item body runs on the worker
+  too). The ring runs these on its WORKER thread, whose sync counters
+  and span ring are thread-local: a host read there would charge syncs
+  the driver's accounting (and the exec-audit sync model's "prefetch
+  worker = 0" row) never sees, and a span would land in the
+  ``unattributed`` diagnostics ring instead of the query's trace.
+  Resolution mirrors ``host-sync-in-shard-map``: name-based (module-
+  local), one level down into module-local helpers. Error severity —
+  the worker's zero-sync contract is what lets ingest leave the driver
+  thread at all.
 * ``chunk-loop-host-sync`` — a host-sync primitive (``.item()``,
   ``np.asarray``/``np.array``, ``device_get``, ``.to_int()``, or the
   engine's ``host_sync``/``count_int``/``resolve_counts``) lexically
@@ -191,6 +207,37 @@ def _collect_shard_bodies(tree) -> set:
     return bodies
 
 
+def _collect_prefetch_bodies(tree) -> set:
+    """Names of callables the prefetch ring runs on its worker thread:
+    arguments of a ring constructor (``chunk_ring``/``ChunkRing``) —
+    positional or keyword, bare name or ``self.method`` — PLUS the
+    callee of a call expression passed as the source iterator
+    (``chunk_ring(scan.device_chunks(self), ...)``: the generator's
+    per-item body runs on the worker too). Name-based like the
+    shard/pallas collectors: a collision only widens coverage."""
+    bodies = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name not in ("chunk_ring", "ChunkRing"):
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                bodies.add(arg.id)
+            elif isinstance(arg, ast.Attribute):
+                bodies.add(arg.attr)
+            elif isinstance(arg, ast.Call):
+                cf = arg.func
+                if isinstance(cf, ast.Name):
+                    bodies.add(cf.id)
+                elif isinstance(cf, ast.Attribute):
+                    bodies.add(cf.attr)
+    return bodies
+
+
 def _collect_pallas_bodies(tree) -> set:
     """Names of functions passed as the first argument to a
     ``pallas_call`` anywhere in the module (``pl.pallas_call(kernel,
@@ -242,13 +289,16 @@ class _Lint(ast.NodeVisitor):
     def __init__(self, path: str, rel: str, source: str,
                  sync_helpers: dict | None = None,
                  shard_bodies: set | None = None,
-                 pallas_bodies: set | None = None):
+                 pallas_bodies: set | None = None,
+                 prefetch_bodies: set | None = None):
         self.rel = rel
         self.sync_helpers = sync_helpers or {}
         self.shard_bodies = shard_bodies or set()
         self.shard_depth = 0         # inside a shard_map/pjit body
         self.pallas_bodies = pallas_bodies or set()
         self.pallas_depth = 0        # inside a pallas_call kernel body
+        self.prefetch_bodies = prefetch_bodies or set()
+        self.prefetch_depth = 0      # inside a prefetch-worker callable
         self.lines = source.splitlines()
         self.findings: list = []
         self.scope_stack = ["<module>"]
@@ -334,6 +384,8 @@ class _Lint(ast.NodeVisitor):
         self.shard_depth += is_shard
         is_pallas = node.name in self.pallas_bodies
         self.pallas_depth += is_pallas
+        is_prefetch = node.name in self.prefetch_bodies
+        self.prefetch_depth += is_prefetch
         saved_loop = self.loop_depth
         saved_chunk = self.chunk_loop_depth
         self.loop_depth = 0
@@ -343,6 +395,7 @@ class _Lint(ast.NodeVisitor):
         self.chunk_loop_depth = saved_chunk
         self.shard_depth -= is_shard
         self.pallas_depth -= is_pallas
+        self.prefetch_depth -= is_prefetch
         self.jit_params.pop()
         if jit_static is not None:
             self.jit_depth -= 1
@@ -536,10 +589,58 @@ class _Lint(ast.NodeVisitor):
                        "pallas_call kernel body: a host sync hidden one "
                        "level down", node.lineno)
 
+    def _check_prefetch_sync(self, node) -> None:
+        """Flag host reads / spans inside a callable the prefetch ring
+        runs on its worker thread: the worker's sync counters and span
+        ring are thread-local, so a sync there escapes the driver's
+        accounting (the exec-audit "prefetch worker = 0 host syncs"
+        row) and a span lands unattributed."""
+        if not self.prefetch_depth:
+            return
+        f = node.func
+        what = _sync_primitive(node)
+        if what is None:
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in _HOST_READ_FUNCS:
+                what = f"{f.attr}()"
+            elif isinstance(f, ast.Name) and f.id in _HOST_READ_FUNCS:
+                what = f"{f.id}()"
+        is_span = (isinstance(f, ast.Attribute) and f.attr == "span"
+                   and isinstance(f.value, ast.Name)
+                   and f.value.id in self.obs_aliases) or \
+            (isinstance(f, ast.Name) and f.id in self.span_funcs)
+        if what or is_span:
+            self._emit("host-sync-in-prefetch-worker", "error",
+                       f"{what or 'obs.span(...)'} inside a prefetch-"
+                       "ring worker callable: the worker's sync "
+                       "counters and span ring are thread-local — a "
+                       "host read there escapes the driver's sync "
+                       "accounting and a span lands unattributed; "
+                       "resolve on the driver before handing work to "
+                       "the ring", node.lineno)
+            return
+        # one level down: a module-local helper whose body syncs directly
+        key = None
+        if isinstance(f, ast.Name):
+            key = (None, f.id)
+        elif isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and self.class_stack:
+            key = (self.class_stack[-1], f.attr)
+        hit = key is not None and self.sync_helpers.get(key)
+        if hit:
+            lineno, prim = hit
+            self._emit("host-sync-in-prefetch-worker", "error",
+                       f"{key[1]}() (defined in this module, syncs via "
+                       f"{prim} at line {lineno}) called inside a "
+                       "prefetch-ring worker callable: a host sync "
+                       "hidden one level down", node.lineno)
+
     def visit_Call(self, node):
         self._check_chunk_loop_sync(node)
         self._check_shard_map_sync(node)
         self._check_pallas_sync(node)
+        self._check_prefetch_sync(node)
         f = node.func
         if isinstance(f, ast.Attribute):
             owner = f.value.id if isinstance(f.value, ast.Name) else None
@@ -772,7 +873,8 @@ def lint_file(path: str, rel: str | None = None) -> list:
         return [Finding(rel, "<module>", "syntax-error", "error",
                         str(e), e.lineno or 0)]
     lint = _Lint(path, rel, source, _collect_sync_helpers(tree),
-                 _collect_shard_bodies(tree), _collect_pallas_bodies(tree))
+                 _collect_shard_bodies(tree), _collect_pallas_bodies(tree),
+                 _collect_prefetch_bodies(tree))
     lint.visit(tree)
     lint.finish()
     return lint.findings

@@ -216,6 +216,12 @@ def type_width(t: str) -> int:
 # executor can never read the flag differently
 from nds_tpu.io.columnar import encoded_enabled  # noqa: E402
 
+# the ONE NDS_TPU_PREFETCH_DEPTH reader (engine/prefetch.py — stdlib
+# only), shared with the runtime so the ring's live-set pricing below
+# and the executor's admission arithmetic can never read the knob
+# differently
+from nds_tpu.engine.prefetch import prefetch_depth  # noqa: E402
+
 
 # spec-fixed value-domain upper bounds (TPC-DS: quantities are 1..100,
 # inventory levels 0..1000) — int64 columns a FOR encoding provably
@@ -745,6 +751,13 @@ class MemModel:
         # shard bound divides the survivor share over the mesh exactly
         # like the partition share rule (shard_row_bound)
         self.shards = stream_shards_env()
+        # async-ingest knob (NDS_TPU_PREFETCH_DEPTH, engine/prefetch.py):
+        # up to ``depth`` prepared chunks wait in the bounded prefetch
+        # ring beyond the two the drive loop already holds — priced into
+        # every streamed peak and subtracted from the capacity admission
+        # decisions see (the executor mirrors this at pipeline build:
+        # the lockstep rule). Depth <= 0 = ring off, priced zero.
+        self.prefetch_depth = max(prefetch_depth(), 0)
         if catalog is None:
             catalog = {
                 t: {f.name.lower(): type_width(f.type) for f in fields}
@@ -808,13 +821,30 @@ class MemModel:
         return min(base, partition_row_bound(stream_rows, n_partitions, k,
                                              self.fanout, self.skew))
 
+    def ring_bytes(self, chunk_row_width: int) -> int:
+        """Extra live bytes of the bounded prefetch ring: ``depth`` more
+        padded chunks resident beyond the in-flight pair the chunk-bytes
+        term already prices. Comes off the admitting capacity and joins
+        the streamed peak — the static twin of ``stream._ring_bytes``
+        (which prices the ACTUAL first-chunk upload bytes; this model
+        prices the conservative ``chunk_cap × pruned width``)."""
+        return self.prefetch_depth * self.chunk_cap() \
+            * max(int(chunk_row_width), 0)
+
+    def admit_capacity(self, chunk_row_width: int) -> int:
+        """Capacity the streamed admission decisions compare against:
+        ``NDS_TPU_HBM_BYTES`` minus the prefetch ring's live set."""
+        return max(self.capacity_bytes - self.ring_bytes(chunk_row_width),
+                   1)
+
     def bare_scan_fits(self, table: str | None, needed: set | None) -> bool:
         """Can a bare streamed scan of ``table`` (no filter, no join: the
         survivor accumulator keeps every row) be proven to fit? True when
-        the proven accumulator bound fits the capacity model AND the env
-        ceiling (if one is set) admits the table's rows — exactly the
-        condition under which the runtime's proof-sized accumulator can
-        never trip the overflow rerun. This is the predicate that retires
+        the proven accumulator bound fits the capacity model (net of the
+        prefetch ring's live set) AND the env ceiling (if one is set)
+        admits the table's rows — exactly the condition under which the
+        runtime's proof-sized accumulator can never trip the overflow
+        rerun. This is the predicate that retires
         ``accumulator-overflow`` fallbacks (`exec_audit` lockstep)."""
         rows = self.row_bounds.get(table or "")
         if rows is None:
@@ -822,8 +852,8 @@ class MemModel:
         if self.acc_ceiling is not None and rows > self.acc_ceiling:
             return False                   # hard ceiling: overflow certain
         bound = self.acc_row_bound(rows, 0)
-        return bound * self.pruned_width(table, needed, encoded=True) \
-            <= self.capacity_bytes
+        w = self.pruned_width(table, needed, encoded=True)
+        return bound * w <= self.admit_capacity(w)
 
 
 # ---------------------------------------------------------------------------
@@ -856,6 +886,10 @@ class ScanBound:
     #                                 unit bound x row width — the
     #                                 allocation unit a sharded pipeline's
     #                                 per-shard overflow flags enforce
+    ring_bytes: int = 0        # prefetch-ring live set (depth x one
+    #                            padded chunk) priced into the streamed
+    #                            peak and off the admitting capacity
+    #                            (NDS_TPU_PREFETCH_DEPTH; 0 = ring off)
 
     @property
     def provable(self) -> bool:
@@ -897,6 +931,7 @@ class MemReport:
                        else int(s.shard_rows),
                        "shard_bytes": None if s.shard_bytes is None
                        else int(s.shard_bytes),
+                       "ring_bytes": int(s.ring_bytes),
                        "provable": s.provable} for s in self.scans],
             "detail": self.detail,
         }
@@ -1459,6 +1494,12 @@ class MemAuditor:
         kept = parts[keep]
         k = stream_graph_fanout(part_cols, sources, keep, conjuncts)
         chunk_bytes = self.model.chunk_cap() * kept.width
+        # async ingest: the bounded prefetch ring holds up to ``depth``
+        # MORE prepared chunks beyond the in-flight pair — priced into
+        # the peak below and off the capacity every admission decision
+        # here compares against (lockstep with engine/stream.py)
+        ring_bytes = self.model.ring_bytes(kept.width)
+        admit_cap = self.model.admit_capacity(kept.width)
         n_parts, part_rows, part_bytes = 1, None, None
         if k is not None:
             acc_rows = self.model.acc_row_bound(kept.rows, k)
@@ -1472,14 +1513,14 @@ class MemAuditor:
             # is proven per partition instead — the rule the executor
             # mirrors at pipeline build (engine/stream.py)
             forced = self.model.partitions
-            if (acc_bytes > self.model.capacity_bytes
+            if (acc_bytes > admit_cap
                     or (forced is not None and forced > 1)):
                 keys = stream_partition_keys(part_cols, sources, keep,
                                              conjuncts)
                 if keys:
                     p, _ = choose_partitions(
                         kept.rows, k, self.model.fanout,
-                        max(merged.width, 1), self.model.capacity_bytes,
+                        max(merged.width, 1), admit_cap,
                         forced=forced, skew=self.model.skew)
                     if p > 1:
                         n_parts = p
@@ -1515,9 +1556,11 @@ class MemAuditor:
                        acc_rows, acc_bytes, chunk_bytes,
                        partitions=n_parts, part_rows=part_rows,
                        part_bytes=part_bytes, shards=n_shards,
-                       shard_rows=srows, shard_bytes=sbytes)
+                       shard_rows=srows, shard_bytes=sbytes,
+                       ring_bytes=ring_bytes)
         cost.scans.append(sb)
-        # working set: two chunks in flight + the survivor accumulator(s)
+        # working set: two chunks in flight + the prefetch ring's live
+        # set (depth more prepared chunks) + the survivor accumulator(s)
         # (partitioned: every partition's proof-sized accumulator is live
         # until the single materializing sync; eager: the concatenated
         # survivor union)
@@ -1527,7 +1570,7 @@ class MemAuditor:
             held = acc_bytes
         else:
             held = _bucket(max(survivors, 1)) * merged.width
-        cost.peak += 2 * chunk_bytes + held
+        cost.peak += 2 * chunk_bytes + ring_bytes + held
         merged.rows = survivors
         return merged
 
@@ -1653,30 +1696,38 @@ def _human(n) -> str:
 def format_mem_report(reports) -> str:
     """The per-statement bound table (``tools/lint.py --mem-report``)."""
     cap = hbm_capacity_bytes()
+    depth = max(prefetch_depth(), 0)
     lines = ["# mem-audit: per-statement peak-HBM byte bounds",
-             f"# capacity model: {_human(cap)} (NDS_TPU_HBM_BYTES)",
+             f"# capacity model: {_human(cap)} (NDS_TPU_HBM_BYTES); "
+             f"prefetch ring depth {depth} (NDS_TPU_PREFETCH_DEPTH) — "
+             "ring live set (depth x chunk bytes) priced into every "
+             "streamed peak and off the admitting capacity",
              f"{'template':<18} {'mode':<9} {'peak':>9}  accumulators"]
     worst = 0
     for r in reports:
         worst = max(worst, r.peak_bytes)
         bits = []
         for s in r.scans:
+            ring = f" + ring {_human(s.ring_bytes)}" if s.ring_bytes \
+                else ""
             if s.provable and s.shards > 1:
                 bits.append(f"{s.table}: S={s.shards}"
                             + (f" x P={s.partitions}"
                                if s.partitions > 1 else "")
                             + f" x {_human(s.shard_bytes)}/shard "
                             f"({s.shard_rows:,} rows/shard, "
-                            f"k={s.fanout_k})")
+                            f"k={s.fanout_k}){ring}")
             elif s.provable and s.partitions > 1:
                 bits.append(f"{s.table}: P={s.partitions} x "
                             f"{_human(s.part_bytes)}/part "
-                            f"({s.part_rows:,} rows/part, k={s.fanout_k})")
+                            f"({s.part_rows:,} rows/part, "
+                            f"k={s.fanout_k}){ring}")
             elif s.provable:
                 bits.append(f"{s.table}: {_human(s.acc_bytes)} "
-                            f"({s.acc_rows:,} rows, k={s.fanout_k})")
+                            f"({s.acc_rows:,} rows, k={s.fanout_k})"
+                            f"{ring}")
             else:
-                bits.append(f"{s.table}: unprovable (eager loop)")
+                bits.append(f"{s.table}: unprovable (eager loop){ring}")
         lines.append(f"{r.query:<18} {r.mode:<9} "
                      f"{_human(r.peak_bytes):>9}  " + "; ".join(bits))
     lines.append(f"# {len(reports)} statements — worst peak bound "

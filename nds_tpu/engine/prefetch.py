@@ -1,0 +1,219 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Bounded prefetch ring: the asynchronous ingest half of the data plane.
+
+Every byte the streamed executor consumes used to enter through ONE host
+thread doing the arrow slice, narrow-codec encode and ``jax.device_put``
+INLINE in the drive loop — the "double-buffered prefetch" was depth-1
+and serial on the driver (dispatch asynchrony hid the device compute,
+never the host-side slice+encode). This module moves that host work off
+the driver thread:
+
+* a single WORKER thread pulls upcoming chunks from the source iterator
+  (``ChunkedTable.padded_chunks`` / the eager loop's ``device_chunks``),
+  applies the caller's ``prepare`` step (flatten + nbytes accounting +
+  sharded placement — the host slice, encode and upload), and hands the
+  ready payloads through a bounded queue;
+* the queue depth (``NDS_TPU_PREFETCH_DEPTH``, read at ring-BUILD time,
+  default 2) is the BACKPRESSURE bound: the worker blocks once ``depth``
+  prepared chunks are waiting, so the ring's extra live set is exactly
+  ``depth x chunk bytes`` — the number ``analysis/mem_audit.py`` prices
+  into pipeline admission (the lockstep rule);
+* delivery is ORDERED by construction (one worker, one FIFO queue):
+  chunk k always arrives before chunk k+1, which the accumulator
+  scatter and the partition histogram rely on only for determinism of
+  the trace labels — the math itself is order-independent;
+* ``close()`` is the clean shutdown: it signals the worker, drains the
+  queue so a backpressure-blocked ``put`` wakes, and joins the thread —
+  called from the drive loops' ``finally`` so an overflow/eager-rerun or
+  a trace-divergence exception never leaks a thread or pins payloads;
+* a worker exception is PROPAGATED: it rides the queue as an error
+  payload and re-raises in the driver at the next fetch, so a corrupt
+  chunk store or a codec bug fails the statement exactly like the
+  inline path would (strict mode and the eager fallback both see the
+  original exception).
+
+``depth <= 0`` disables the ring entirely: :func:`chunk_ring` returns an
+inline pump that runs ``prepare`` on the driver thread at each fetch —
+bit-for-bit today's path (same thread, same order, same dispatch
+interleaving), the escape hatch and the A/B baseline of the slow-source
+differential (``tests/test_prefetch.py``).
+
+Contract for ``prepare`` (and the source iterator's per-item work, which
+also runs on the worker): NO host reads and NO spans. The worker thread
+has its own thread-local sync counters and span ring, so a sync there
+would vanish from the driver's accounting and a span would land in the
+``unattributed`` diagnostics ring — the ``host-sync-in-prefetch-worker``
+jax_lint rule (error severity) rejects both statically, and the conc
+audit's ring-liveness probe (``tools/conc_audit_diff.py``) exercises the
+shutdown path under real threads. Slice + encode + ``device_put`` are
+all sync-free by construction (numpy work plus an async upload), which
+is why the whole ingest step can leave the driver thread at all.
+
+The driver-side fetch (:meth:`ChunkRing.next_chunk`) accumulates the
+time the driver spent BLOCKED waiting on the ring (``stall_ns``) — the
+number ``StreamEvent.prefetch_stall_ms`` surfaces per scan and
+``tools/trace_report.py`` prices as its own phase column: overlap is
+evidence, not assertion. With the ring disabled the same counter holds
+the inline slice+encode+upload time (the cost the ring exists to hide),
+so the depth-0 vs depth-N differential reads directly off the events.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+# sentinel kinds riding the queue (payloads are (kind, value) pairs)
+_ITEM = "item"
+_DONE = "done"
+_ERR = "err"
+
+# how long a blocked worker put waits between shutdown checks: short
+# enough that close() never stalls the caller, long enough to stay off
+# the scheduler's back during normal backpressure
+_PUT_POLL_S = 0.05
+
+
+def prefetch_depth() -> int:
+    """``NDS_TPU_PREFETCH_DEPTH``: bounded ring depth (chunks the worker
+    may run ahead of the driver). Read at ring-BUILD time, never frozen
+    at import (the PR 6/13 env-knob discipline); ``<= 0`` disables the
+    ring — the inline, bit-for-bit-today path. Default 2: one chunk
+    uploading while one sits ready, matching the double-buffer the
+    drive loop's async dispatch already assumed."""
+    try:
+        return int(os.environ.get("NDS_TPU_PREFETCH_DEPTH", "2"))
+    except ValueError:
+        return 2
+
+
+class _InlineRing:
+    """Depth-0 escape hatch: same interface, no thread — ``prepare``
+    runs on the driver at each fetch, exactly the pre-ring drive loop.
+    ``stall_ns`` then measures the inline host fetch (slice + encode +
+    upload) so the differential against a live ring is observable."""
+
+    def __init__(self, it, prepare=None):
+        self._it = iter(it)
+        self._prepare = prepare
+        self.stall_ns = 0
+
+    def next_chunk(self):
+        t0 = time.perf_counter_ns()
+        try:
+            item = next(self._it, None)
+            if item is None:
+                return None
+            return item if self._prepare is None else self._prepare(item)
+        finally:
+            self.stall_ns += time.perf_counter_ns() - t0
+
+    def stall_ms(self) -> float:
+        return self.stall_ns / 1e6
+
+    def close(self) -> None:
+        self._it = iter(())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ChunkRing:
+    """Bounded, ordered, single-worker prefetch ring over one chunk
+    iterator. See the module docstring for the full contract."""
+
+    def __init__(self, it, prepare=None, depth=2, name="nds-prefetch"):
+        self._q: queue.Queue = queue.Queue(maxsize=max(int(depth), 1))
+        self._stop = threading.Event()
+        self._exhausted = False
+        self.stall_ns = 0
+        self._thread = threading.Thread(
+            target=self._work, args=(iter(it), prepare), daemon=True,
+            name=name)
+        self._thread.start()
+
+    # ------------------------------------------------------------ worker
+
+    def _put(self, payload) -> bool:
+        """Backpressure-bounded put that stays responsive to shutdown:
+        returns False when the ring closed while waiting."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(payload, timeout=_PUT_POLL_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work(self, it, prepare) -> None:
+        try:
+            for item in it:
+                if self._stop.is_set():
+                    return
+                payload = item if prepare is None else prepare(item)
+                if not self._put((_ITEM, payload)):
+                    return
+            self._put((_DONE, None))
+        except BaseException as exc:  # propagate to the driver, always
+            self._put((_ERR, exc))
+
+    # ------------------------------------------------------------ driver
+
+    def next_chunk(self):
+        """Next prepared payload, or None at end of stream. Re-raises a
+        worker exception at the point the inline path would have raised
+        it. The blocked wait is accumulated into ``stall_ns``."""
+        if self._exhausted:
+            return None
+        t0 = time.perf_counter_ns()
+        kind, value = self._q.get()
+        self.stall_ns += time.perf_counter_ns() - t0
+        if kind is _ITEM:
+            return value
+        self._exhausted = True
+        if kind is _ERR:
+            self.close()
+            raise value
+        return None
+
+    def stall_ms(self) -> float:
+        """Driver milliseconds spent blocked on the ring so far — the
+        ``StreamEvent.prefetch_stall_ms`` evidence."""
+        return self.stall_ns / 1e6
+
+    def close(self) -> None:
+        """Clean shutdown (idempotent): signal the worker, drain the
+        queue so a backpressure-blocked put wakes, join the thread."""
+        self._stop.set()
+        self._exhausted = True
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=60.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def chunk_ring(it, prepare=None, depth=None, name="nds-prefetch"):
+    """The ONE ring constructor the drive loops use: a :class:`ChunkRing`
+    when the (build-time) depth is positive, the inline pump otherwise.
+    ``prepare`` runs on the worker thread — it must never host-read or
+    open a span (``host-sync-in-prefetch-worker`` enforces this
+    statically)."""
+    d = prefetch_depth() if depth is None else int(depth)
+    if d <= 0:
+        return _InlineRing(it, prepare)
+    return ChunkRing(it, prepare, depth=d, name=name)

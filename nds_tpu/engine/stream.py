@@ -125,6 +125,22 @@ factor of the per-partition and per-shard bounds, default 2),
 devices = single-device), ``NDS_TPU_STREAM_MESH_AXIS`` (mesh axis name,
 default ``shard``), ``NDS_TPU_STREAM_EXCHANGE`` (0 disables the
 partitioned hash-exchange pass).
+
+ASYNC INGEST (DESIGN.md "Async ingest"): all three drive loops and the
+eager chunk loop pull chunks through the bounded prefetch ring of
+``engine/prefetch.py`` (``NDS_TPU_PREFETCH_DEPTH``, default 2; 0 = the
+inline pump, bit-for-bit the old loops): a worker thread runs the host
+slice + narrow encode + async upload for upcoming chunks — sharded
+runs place each shard's row slice on its own device inside the worker —
+while the driver dispatches compute, and the driver's blocked-on-ring
+time is measured per scan as ``StreamEvent.prefetch_stall_ms``. The
+ring's extra live set (depth × chunk bytes) is priced off the admitting
+capacity by every accumulator-sizing decision here and by
+``mem_audit`` statically (the lockstep rule), and the depth joins the
+pipeline-cache key. ``NDS_TPU_CHUNK_STORE`` points chunk production at
+the persistent pre-encoded store (``io/chunk_store.py``): warm runs
+mmap whole-table wire arrays instead of slicing arrow and re-planning
+codecs.
 """
 
 from __future__ import annotations
@@ -139,6 +155,7 @@ import jax.numpy as jnp
 
 from nds_tpu.engine import kernels as _K
 from nds_tpu.engine import ops as E
+from nds_tpu.engine import prefetch as _PF
 from nds_tpu.engine.column import Column, slice_col_prefix
 from nds_tpu.engine.table import DeviceTable
 from nds_tpu.listener import record_stream_event
@@ -205,8 +222,20 @@ def _proved_plan(parts, keep, join_preds, where_conjuncts, sources, nrows):
         return None, None, None
 
 
+def _ring_bytes(chunk_nbytes: int) -> int:
+    """Extra live bytes of the bounded prefetch ring: up to
+    ``NDS_TPU_PREFETCH_DEPTH`` prepared chunks wait in the ring beyond
+    the one the dispatch loop is consuming. Priced into every admission
+    decision below (effective capacity = NDS_TPU_HBM_BYTES − ring) so
+    turning the ring up can never size accumulators into memory the
+    ring itself is holding — the lockstep twin of
+    ``mem_audit.MemModel.ring_bytes``. Depth <= 0 (ring off) prices
+    zero: bit-for-bit today's admission arithmetic."""
+    return max(_PF.prefetch_depth(), 0) * max(int(chunk_nbytes), 0)
+
+
 def _partition_plan(nrows, fan_k, part_keys, proved, row_bytes, n_chunks,
-                    chunk_out_plen):
+                    chunk_out_plen, ring_bytes=0):
     """``(n_partitions, per_partition_row_bound)`` for the pipeline being
     built: >1 only for a provable graph with chunk-side equi keys whose
     whole bound is past capacity (or when NDS_TPU_STREAM_PARTITIONS pins
@@ -216,7 +245,8 @@ def _partition_plan(nrows, fan_k, part_keys, proved, row_bytes, n_chunks,
     size — ``min(chunk-sum, structural)``, clamped by the env ceiling —
     is what gets compared against capacity (an explicit ceiling already
     pins the allocation, so capacity pressure never forces a partition
-    pass under it)."""
+    pass under it). ``ring_bytes`` — the prefetch ring's live set —
+    comes off the capacity side."""
     if fan_k is None or not part_keys or proved is None:
         return 1, None
     try:
@@ -227,46 +257,52 @@ def _partition_plan(nrows, fan_k, part_keys, proved, row_bytes, n_chunks,
         ceiling = _acc_ceiling()
         if ceiling is not None:
             bound = min(bound, ceiling)
-        need = bound * row_bytes > _hbm_bytes()
+        cap = max(_hbm_bytes() - ring_bytes, 1)
+        need = bound * row_bytes > cap
         if not need and (forced is None or forced <= 1):
             return 1, None
         return choose_partitions(int(nrows), fan_k, E.stream_fanout(),
-                                 row_bytes, _hbm_bytes(), forced=forced)
+                                 row_bytes, cap, forced=forced)
     except Exception:
         return 1, None
 
 
-def _acc_row_budget(n_chunks, chunk_out_plen, proved, row_bytes):
+def _acc_row_budget(n_chunks, chunk_out_plen, proved, row_bytes,
+                    ring_bytes=0):
     """Rows the survivor accumulator is sized for. Always bounded by the
     per-chunk-bucket sum (each chunk contributes at most its output
     bucket); the proof tightens it. The env ceiling, when set, stays a
     hard clamp (overflow then reruns eagerly — correctness never depends
     on the proof); without one, a bound the capacity model cannot admit
-    falls back to the legacy guess."""
+    falls back to the legacy guess. ``ring_bytes`` (prefetch live set)
+    shrinks the admitting capacity."""
     rows = n_chunks * chunk_out_plen
     if proved is not None:
         rows = min(rows, proved)
     ceiling = _acc_ceiling()
     if ceiling is not None:
         return min(rows, ceiling)
-    if proved is None or rows * row_bytes > _hbm_bytes():
+    if proved is None or \
+            rows * row_bytes > max(_hbm_bytes() - ring_bytes, 1):
         return min(rows, _DEFAULT_ACC_ROWS)
     return rows
 
 
 def _part_acc_budget(n_chunks, chunk_out_plen, part_bound, row_bytes,
-                     n_parts):
+                     n_parts, ring_bytes=0):
     """Per-partition accumulator rows. The per-partition proof admits the
     bound by construction (choose_partitions), but every partition's
     accumulator is live until the single materializing sync, so the
     TOTAL allocation is additionally clamped to the capacity model —
     past it, actual survivors beyond the clamp trip the per-partition
     overflow flag and rerun eagerly (a perf fallback, never a
-    correctness one). The env ceiling stays a hard per-partition clamp."""
+    correctness one). The env ceiling stays a hard per-partition clamp;
+    the prefetch ring's live set comes off the capacity side."""
     rows = n_chunks * chunk_out_plen
     if part_bound is not None:
         rows = min(rows, part_bound)
-    share = _hbm_bytes() // max(n_parts * row_bytes, 1)
+    share = max(_hbm_bytes() - ring_bytes, 1) // max(n_parts * row_bytes,
+                                                     1)
     rows = min(rows, max(share, chunk_out_plen))
     ceiling = _acc_ceiling()
     if ceiling is not None:
@@ -884,6 +920,34 @@ class StreamPipeline:
             flat.append(c.valid)
         return tuple(flat)
 
+    def _prepare_chunk(self, chunk: DeviceTable):
+        """The per-chunk host work the prefetch ring runs OFF the driver
+        thread: flatten the padded chunk's buffers (the jnp conversion
+        inside ``padded_chunks`` already queued the async upload), stamp
+        the live count, and account the actual h2d bytes. NO host reads,
+        NO spans — the ``host-sync-in-prefetch-worker`` contract (padded
+        chunks carry a plain-int live count, so no DeviceCount resolve
+        is ever needed here)."""
+        flat = self._flatten_chunk(chunk)
+        n_dev = jnp.asarray(int(chunk.nrows), dtype=jnp.int64)
+        h2d = sum(int(x.nbytes) for x in flat if x is not None)
+        return flat, n_dev, h2d
+
+    def _prepare_chunk_sharded(self, chunk: DeviceTable):
+        """Sharded twin of :meth:`_prepare_chunk`: additionally places
+        each shard's row slice on its own device (row-sharded
+        ``device_put``) INSIDE the worker, so the h2d uploads fan out
+        across the mesh off the driver thread instead of funneling
+        through one inline upload."""
+        from jax.sharding import NamedSharding, PartitionSpec as PSpec
+        row = NamedSharding(self.mesh, PSpec(self.mesh_axis))
+        flat = self._flatten_chunk(chunk)
+        n_dev = jnp.asarray(int(chunk.nrows), dtype=jnp.int64)
+        h2d = sum(int(x.nbytes) for x in flat if x is not None)
+        flat = tuple(None if x is None else jax.device_put(x, row)
+                     for x in flat)
+        return flat, n_dev, h2d
+
     def _first_kern(self, attr, call):
         """Capture trace-time fused-kernel launch counts on the first
         (tracing) dispatch of one compiled program — the same pattern
@@ -974,46 +1038,60 @@ class StreamPipeline:
             return self._run_partitioned(chunks, first_chunk, parts_flat,
                                          resid_flat)
         acc = self.init_acc()
-        cur = first_chunk
+        # bounded prefetch ring (engine/prefetch.py): a worker thread
+        # runs the host slice + encode + async upload for upcoming
+        # chunks while the driver below dispatches compute — depth 0
+        # (NDS_TPU_PREFETCH_DEPTH=0) degrades to the inline pump, bit
+        # for bit the old drive loop. The first chunk was already
+        # converted by the record phase, so it prepares inline.
+        ring = _PF.chunk_ring(chunks, prepare=self._prepare_chunk)
         n_chunks = 0
         h2d = 0
-        while cur is not None:
-            n_dev = jnp.asarray(E.count_int(cur.nrows), dtype=jnp.int64)
-            flat = self._flatten_chunk(cur)
-            # actual host->device prefetch bytes (buffer metadata, no
-            # sync): encoded columns upload their NARROW representation
-            h2d += sum(int(x.nbytes) for x in flat if x is not None)
-            # asynchronous dispatch: the compiled call returns immediately,
-            # so the NEXT chunk's arrow->device conversion (host slice +
-            # upload) below overlaps this chunk's device compute — the
-            # double-buffered prefetch. The first dispatch of a fresh
-            # pipeline traces+compiles the per-chunk program; the span
-            # names that cost so the compile-vs-drive split is visible
-            # per chunk in the query trace.
-            live = None
-            if self._scan_jit is not None:
-                # the fused Pallas pre-pass: one VMEM-resident launch
-                # evaluates every lowered predicate; the chunk program
-                # consumes the survivor mask as a lazy compact. Device-
-                # only by construction (zero host syncs — the span's
-                # delta is cross-checked by tools/exec_audit_diff.py)
-                with _obs.span("stream.kernel", chunk=n_chunks):
-                    live = self._first_kern(
-                        "kern_scan",
-                        lambda f=flat, nd=n_dev: self._scan_jit(f, nd))
-            phase = "stream.drive" if self.traced_once else "stream.compile"
-            with _obs.span(phase, chunk=n_chunks):
-                acc = self._first_kern(
-                    "kern_chunk",
-                    lambda a=acc, f=flat, nd=n_dev, lv=live:
-                    self.jitted(f, nd, parts_flat, self.operands, a,
-                                resid_flat, live=lv))
-            self.traced_once = True
-            n_chunks += 1
-            # prefetch span: host-side arrow slice + upload of the next
-            # chunk, overlapping the dispatched compute above
-            with _obs.span("stream.prefetch", chunk=n_chunks):
-                cur = next(chunks, None)
+        try:
+            cur = self._prepare_chunk(first_chunk)
+            while cur is not None:
+                flat, n_dev, nb = cur
+                # actual host->device prefetch bytes (buffer metadata,
+                # no sync): encoded columns upload their NARROW form
+                h2d += nb
+                # asynchronous dispatch: the compiled call returns
+                # immediately, so the ring's conversion of upcoming
+                # chunks overlaps this chunk's device compute. The first
+                # dispatch of a fresh pipeline traces+compiles the
+                # per-chunk program; the span names that cost so the
+                # compile-vs-drive split is visible per chunk.
+                live = None
+                if self._scan_jit is not None:
+                    # the fused Pallas pre-pass: one VMEM-resident launch
+                    # evaluates every lowered predicate; the chunk program
+                    # consumes the survivor mask as a lazy compact. Device-
+                    # only by construction (zero host syncs — the span's
+                    # delta is cross-checked by tools/exec_audit_diff.py)
+                    with _obs.span("stream.kernel", chunk=n_chunks):
+                        live = self._first_kern(
+                            "kern_scan",
+                            lambda f=flat, nd=n_dev: self._scan_jit(f, nd))
+                phase = "stream.drive" if self.traced_once \
+                    else "stream.compile"
+                with _obs.span(phase, chunk=n_chunks):
+                    acc = self._first_kern(
+                        "kern_chunk",
+                        lambda a=acc, f=flat, nd=n_dev, lv=live:
+                        self.jitted(f, nd, parts_flat, self.operands, a,
+                                    resid_flat, live=lv))
+                self.traced_once = True
+                n_chunks += 1
+                # stall span: driver time BLOCKED on the ring for the
+                # next chunk (ring off: the inline slice+upload). Only
+                # real fetches record a span, labeled with the chunk
+                # they fetch; the end-of-stream probe drops its span.
+                with _obs.span("stream.prefetch", chunk=n_chunks) as sp:
+                    cur = ring.next_chunk()
+                    if cur is None:
+                        sp.drop()
+            stall_ms = ring.stall_ms()
+        finally:
+            ring.close()
         datas, valids, n_dev, ovf, bitmaps = acc
         miss = self._outer_miss(bitmaps)
 
@@ -1027,7 +1105,7 @@ class StreamPipeline:
         with _obs.span("stream.materialize", chunks=n_chunks):
             total, overflowed, extras_n = E.timed_read("stream_final",
                                                        fetch)
-        evidence = {"h2d": h2d,
+        evidence = {"h2d": h2d, "stall_ms": stall_ms,
                     "outer": [(slot, m, n) for (slot, (m, _nd), n)
                               in zip(self.build_slots, miss, extras_n)],
                     **self._kernel_evidence(n_chunks, n_chunks)}
@@ -1065,42 +1143,48 @@ class StreamPipeline:
         accs = [self.init_acc() for _ in range(P)]
         hist = jnp.zeros(P, dtype=jnp.int64)
         pid_consts = [jnp.asarray(p, dtype=jnp.int32) for p in range(P)]
-        cur = first_chunk
+        ring = _PF.chunk_ring(chunks, prepare=self._prepare_chunk)
         n_chunks = 0
         h2d = 0
-        while cur is not None:
-            n_dev = jnp.asarray(E.count_int(cur.nrows), dtype=jnp.int64)
-            flat = self._flatten_chunk(cur)
-            h2d += sum(int(x.nbytes) for x in flat if x is not None)
-            mask = None
-            if self._scan_jit is not None:
-                # fused pass: predicates + partition ids + histogram in
-                # ONE VMEM-resident launch (replaces the XLA radix pass)
-                with _obs.span("stream.kernel", chunk=n_chunks,
-                               partitions=P):
-                    mask, pids, hist = self._first_kern(
-                        "kern_scan",
-                        lambda f=flat, nd=n_dev, h=hist:
-                        self._scan_jit(f, nd, h))
-            else:
-                with _obs.span("stream.partition", chunk=n_chunks,
-                               partitions=P):
-                    pids, hist = self._pid_jit(flat, n_dev, hist)
-            for p in range(P):
-                phase = "stream.drive" if self.traced_once \
-                    else "stream.compile"
-                with _obs.span(phase, chunk=n_chunks, part=p):
-                    accs[p] = self._first_kern(
-                        "kern_chunk",
-                        lambda a=accs[p], f=flat, nd=n_dev, pv=pids,
-                        pc=pid_consts[p], lv=mask:
-                        self.jitted(f, nd, parts_flat, self.operands, a,
-                                    resid_flat, pids=pv, part_id=pc,
-                                    live=lv))
-                self.traced_once = True
-            n_chunks += 1
-            with _obs.span("stream.prefetch", chunk=n_chunks):
-                cur = next(chunks, None)
+        try:
+            cur = self._prepare_chunk(first_chunk)
+            while cur is not None:
+                flat, n_dev, nb = cur
+                h2d += nb
+                mask = None
+                if self._scan_jit is not None:
+                    # fused pass: predicates + partition ids + histogram
+                    # in ONE VMEM launch (replaces the XLA radix pass)
+                    with _obs.span("stream.kernel", chunk=n_chunks,
+                                   partitions=P):
+                        mask, pids, hist = self._first_kern(
+                            "kern_scan",
+                            lambda f=flat, nd=n_dev, h=hist:
+                            self._scan_jit(f, nd, h))
+                else:
+                    with _obs.span("stream.partition", chunk=n_chunks,
+                                   partitions=P):
+                        pids, hist = self._pid_jit(flat, n_dev, hist)
+                for p in range(P):
+                    phase = "stream.drive" if self.traced_once \
+                        else "stream.compile"
+                    with _obs.span(phase, chunk=n_chunks, part=p):
+                        accs[p] = self._first_kern(
+                            "kern_chunk",
+                            lambda a=accs[p], f=flat, nd=n_dev, pv=pids,
+                            pc=pid_consts[p], lv=mask:
+                            self.jitted(f, nd, parts_flat, self.operands,
+                                        a, resid_flat, pids=pv,
+                                        part_id=pc, live=lv))
+                    self.traced_once = True
+                n_chunks += 1
+                with _obs.span("stream.prefetch", chunk=n_chunks) as sp:
+                    cur = ring.next_chunk()
+                    if cur is None:
+                        sp.drop()
+            stall_ms = ring.stall_ms()
+        finally:
+            ring.close()
 
         bitmaps = [accs[0][4][j] for j in range(len(self.build_slots))]
         for p in range(1, P):
@@ -1124,6 +1208,7 @@ class StreamPipeline:
                 "stream_final", fetch)
         evidence = {"partitions": P, "part_rows": tuple(totals),
                     "part_input": tuple(hist_host), "h2d": h2d,
+                    "stall_ms": stall_ms,
                     "outer": [(slot, m, n) for (slot, (m, _nd), n)
                               in zip(self.build_slots, miss, extras_n)],
                     **self._kernel_evidence(n_chunks, n_chunks * P)}
@@ -1161,9 +1246,6 @@ def _run_sharded(pipe, chunks, first_chunk, parts_flat, resid_flat=()):
     row = NamedSharding(pipe.mesh, PSpec(pipe.mesh_axis))
     rep = NamedSharding(pipe.mesh, PSpec())
 
-    def put_row(x):
-        return None if x is None else jax.device_put(x, row)
-
     def put_rep(x):
         return None if x is None else jax.device_put(x, rep)
 
@@ -1185,53 +1267,65 @@ def _run_sharded(pipe, chunks, first_chunk, parts_flat, resid_flat=()):
             return out
         return call()
 
-    cur = first_chunk
+    # sharded prefetch ring: the worker places each shard's row slice on
+    # its OWN device (row-sharded device_put inside _prepare_chunk_
+    # sharded), so the h2d bandwidth scales with the mesh instead of
+    # funneling through one inline upload on the driver thread
+    ring = _PF.chunk_ring(chunks, prepare=pipe._prepare_chunk_sharded)
     n_chunks = 0
     h2d = 0
-    while cur is not None:
-        n_dev = jnp.asarray(E.count_int(cur.nrows), dtype=jnp.int64)
-        flat = pipe._flatten_chunk(cur)
-        h2d += sum(int(x.nbytes) for x in flat if x is not None)
-        # the sharded upload: each shard receives its row slice
-        flat = tuple(put_row(x) for x in flat)
-        pids = live = None
-        if pipe.exchange:
-            with _obs.span("stream.exchange", chunk=n_chunks, shards=S,
-                           partitions=P):
-                flat, live, pids, hist, ex_ovf = first_traced(
-                    "coll_exchange",
-                    lambda f=flat, h=hist, o=ex_ovf:
-                    pipe._first_kern("kern_scan",
-                                     lambda: pipe._exch_jit(f, n_dev,
-                                                            h, o)))
-        elif pipe._scan_jit is not None and P > 1:
-            with _obs.span("stream.kernel", chunk=n_chunks,
-                           partitions=P, shards=S):
-                live, pids, hist = pipe._first_kern(
-                    "kern_scan",
-                    lambda f=flat, h=hist: pipe._scan_jit(f, n_dev, h))
-        elif pipe._scan_jit is not None:
-            with _obs.span("stream.kernel", chunk=n_chunks, shards=S):
-                live = pipe._first_kern(
-                    "kern_scan",
-                    lambda f=flat: pipe._scan_jit(f, n_dev))
-        elif P > 1:
-            with _obs.span("stream.partition", chunk=n_chunks,
-                           partitions=P, shards=S):
-                pids, hist = pipe._pid_jit(flat, n_dev, hist)
-        for p in range(P):
-            phase = "stream.drive" if pipe.traced_once else "stream.compile"
-            args = (flat, n_dev, parts_rep, ops_rep, accs[p], resid_rep,
-                    pids, pid_consts[p] if P > 1 else None, live)
-            with _obs.span(phase, chunk=n_chunks, part=p):
-                accs[p] = first_traced(
-                    "coll_chunk",
-                    lambda a=args: pipe._first_kern(
-                        "kern_chunk", lambda: pipe.jitted(*a)))
-            pipe.traced_once = True
-        n_chunks += 1
-        with _obs.span("stream.prefetch", chunk=n_chunks):
-            cur = next(chunks, None)
+    try:
+        cur = pipe._prepare_chunk_sharded(first_chunk)
+        while cur is not None:
+            flat, n_dev, nb = cur
+            h2d += nb
+            pids = live = None
+            if pipe.exchange:
+                with _obs.span("stream.exchange", chunk=n_chunks,
+                               shards=S, partitions=P):
+                    flat, live, pids, hist, ex_ovf = first_traced(
+                        "coll_exchange",
+                        lambda f=flat, nd=n_dev, h=hist, o=ex_ovf:
+                        pipe._first_kern("kern_scan",
+                                         lambda: pipe._exch_jit(f, nd,
+                                                                h, o)))
+            elif pipe._scan_jit is not None and P > 1:
+                with _obs.span("stream.kernel", chunk=n_chunks,
+                               partitions=P, shards=S):
+                    live, pids, hist = pipe._first_kern(
+                        "kern_scan",
+                        lambda f=flat, nd=n_dev, h=hist:
+                        pipe._scan_jit(f, nd, h))
+            elif pipe._scan_jit is not None:
+                with _obs.span("stream.kernel", chunk=n_chunks,
+                               shards=S):
+                    live = pipe._first_kern(
+                        "kern_scan",
+                        lambda f=flat, nd=n_dev: pipe._scan_jit(f, nd))
+            elif P > 1:
+                with _obs.span("stream.partition", chunk=n_chunks,
+                               partitions=P, shards=S):
+                    pids, hist = pipe._pid_jit(flat, n_dev, hist)
+            for p in range(P):
+                phase = "stream.drive" if pipe.traced_once \
+                    else "stream.compile"
+                args = (flat, n_dev, parts_rep, ops_rep, accs[p],
+                        resid_rep, pids,
+                        pid_consts[p] if P > 1 else None, live)
+                with _obs.span(phase, chunk=n_chunks, part=p):
+                    accs[p] = first_traced(
+                        "coll_chunk",
+                        lambda a=args: pipe._first_kern(
+                            "kern_chunk", lambda: pipe.jitted(*a)))
+                pipe.traced_once = True
+            n_chunks += 1
+            with _obs.span("stream.prefetch", chunk=n_chunks) as sp:
+                cur = ring.next_chunk()
+                if cur is None:
+                    sp.drop()
+        stall_ms = ring.stall_ms()
+    finally:
+        ring.close()
 
     # one cross-shard reduce, one materializing transfer
     ns = jnp.stack([a[2] for a in accs], axis=1)          # (S, P)
@@ -1269,7 +1363,7 @@ def _run_sharded(pipe, chunks, first_chunk, parts_flat, resid_flat=()):
     bytes_ici = (bytes_of(pipe.coll_chunk) * dispatches
                  + bytes_of(pipe.coll_exchange) * n_chunks
                  + bytes_of(pipe.coll_reduce))
-    evidence = {"h2d": h2d, "shards": S,
+    evidence = {"h2d": h2d, "shards": S, "stall_ms": stall_ms,
                 "shard_rows": tuple(int(x) for x in counts.sum(axis=1)),
                 "collectives": collectives, "bytes_ici": bytes_ici,
                 "outer": [(slot, m, n) for (slot, (m, n)) in
@@ -1366,6 +1460,10 @@ def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
         # partition count
         _acc_ceiling(), _hbm_bytes(), E.stream_fanout(),
         stream_partitions_env(), stream_skew_factor(), int(stream_rows),
+        # the prefetch ring's depth shapes the admission arithmetic
+        # (effective capacity = HBM − depth × chunk bytes), which sizes
+        # the compiled accumulator shapes — a depth change must MISS
+        _PF.prefetch_depth(),
         # sharded-execution knobs: a pipeline compiled for one mesh shape
         # (or exchange mode) must never serve another
         stream_shards_env(), os.environ.get("NDS_TPU_STREAM_EXCHANGE"),
@@ -1651,6 +1749,7 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
     if extras:
         out = E.concat_tables([out] + extras)
     h2d = evidence.get("h2d", -1)
+    stall_ms = evidence.get("stall_ms", -1.0)
     record_stream_event(alias, ran, E.sync_count() - syncs0, "compiled",
                         rows=survivor_total,
                         partitions=evidence.get("partitions", 1),
@@ -1662,8 +1761,10 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
                         shard_rows=evidence.get("shard_rows", ()),
                         kernel_launches=evidence.get("kernel_launches", 0),
                         kernel_fused_stages=evidence.get("kernel_stages",
-                                                         0))
+                                                         0),
+                        prefetch_stall_ms=stall_ms)
     _obs.annotate(path="compiled", chunks=ran,
+                  prefetchStallMs=stall_ms,
                   partitions=evidence.get("partitions", 1),
                   shards=evidence.get("shards", 1),
                   collectives=evidence.get("collectives", -1),
@@ -1832,9 +1933,17 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
     proved, fan_k, part_keys = _proved_plan(parts, keep, join_preds,
                                             where_conjuncts, masked_sources,
                                             stream_rows)
+    # the prefetch ring's live set (depth × one padded chunk's actual
+    # upload bytes) comes off the capacity every admission decision
+    # below sees — mem_audit prices the same term statically (lockstep)
+    ring_bytes = _ring_bytes(sum(
+        int(first[c].data.nbytes)
+        + (0 if first[c].valid is None else int(first[c].valid.nbytes))
+        for c in first.column_names))
     n_parts, part_bound = _partition_plan(stream_rows, fan_k, part_keys,
                                           proved, max(row_bytes, 1),
-                                          n_chunks, out0.plen)
+                                          n_chunks, out0.plen,
+                                          ring_bytes=ring_bytes)
     key_slots = []
     if n_parts > 1:
         # map the partition keys (bare names) to the chunk's flattened
@@ -1849,10 +1958,12 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
             key_slots.append(2 * hit[0])
     if n_parts > 1:
         budget = _part_acc_budget(n_chunks, out0.plen, part_bound,
-                                  max(row_bytes, 1), n_parts)
+                                  max(row_bytes, 1), n_parts,
+                                  ring_bytes=ring_bytes)
     else:
         budget = _acc_row_budget(n_chunks, out0.plen, proved,
-                                 max(row_bytes, 1))
+                                 max(row_bytes, 1),
+                                 ring_bytes=ring_bytes)
     # mesh-sharded execution: each shard accumulates its own slice, so
     # the budget re-shares over the mesh (skew-factored like the
     # partition share — mem_audit.shard_row_bound, the lockstep rule);

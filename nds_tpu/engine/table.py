@@ -112,6 +112,12 @@ class ChunkedTable:
         # store discipline as the string dictionaries. None marks a column
         # already found unencodable, so the stats pass runs once.
         self._enc_store: dict = {}
+        # persistent wire plans (io/chunk_store.py, NDS_TPU_CHUNK_STORE):
+        # one whole-table pre-encoded plan per column set, loaded (mmap)
+        # or built+saved once — shared across select() views like the
+        # codec stores above. Keyed by column-name tuple so a pruned
+        # view's plan never serves the full table's.
+        self._wire_store: dict = {}
 
     @property
     def nrows(self) -> int:
@@ -130,6 +136,7 @@ class ChunkedTable:
                            self.chunk_rows)
         out._str_store = self._str_store
         out._enc_store = self._enc_store
+        out._wire_store = self._wire_store
         return out
 
     def device_chunks(self):
@@ -221,6 +228,74 @@ class ChunkedTable:
                 out[name] = got
         return out
 
+    def _wire_plan(self):
+        """``name -> io.chunk_store.WireColumn`` when the persistent
+        chunk store is active (``NDS_TPU_CHUNK_STORE``): the whole-table
+        pre-encoded wire arrays ``padded_chunks`` slices per chunk. A
+        warm store entry memory-maps straight back (no arrow slicing, no
+        codec planning); a miss or a stale fingerprint builds the plan
+        from the live codecs and persists it. None when the store is off
+        — ``padded_chunks`` then keeps the inline arrow path, bit for
+        bit."""
+        from nds_tpu.io import chunk_store
+        from nds_tpu.io.columnar import encoded_enabled
+        root = chunk_store.store_root()
+        if root is None:
+            return None
+        # keyed by column set AND the encoded gate: a post-build
+        # NDS_TPU_ENCODED flip must rebuild (the on-disk entry's
+        # fingerprint covers the same flag, so disk stays honest too)
+        key = (tuple(self.arrow.column_names), encoded_enabled())
+        hit = self._wire_store.get(key)
+        if hit is not None:
+            return hit
+        plan = chunk_store.load_plan(root, self.arrow,
+                                     self.canonical_types)
+        if plan is None:
+            plan = self._build_wire_plan()
+            # persisting is best-effort: a full disk, a read-only store
+            # or a concurrent writer's rename race must degrade to the
+            # in-memory plan just built, never fail the statement (a
+            # LOAD problem — version drift, checksum — stays loud)
+            try:
+                chunk_store.save_plan(root, self.arrow,
+                                      self.canonical_types, plan)
+            except Exception as exc:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "chunk store save failed (%s); serving the "
+                    "in-memory wire plan for this process", exc)
+        self._wire_store[key] = plan
+        return plan
+
+    def _build_wire_plan(self) -> dict:
+        """The wire form of every column, from the live whole-table
+        codecs: string dictionaries, narrow FOR/dict codes, and a host
+        lowering of the remaining plain columns — exactly the arrays the
+        inline ``padded_chunks`` path derives, assembled once so the
+        chunk store can persist them."""
+        from nds_tpu import types as _t
+        from nds_tpu.io.chunk_store import WireColumn, lower_plain_column
+        strings = self._string_encodings()
+        narrow = self._int_encodings()
+        plan = {}
+        for name in self.arrow.column_names:
+            ct = self.canonical_types.get(name) or _t.arrow_to_canonical(
+                self.arrow.schema.field(name).type)
+            if name in strings:
+                codes, values, valid = strings[name]
+                plan[name] = WireColumn("str", codes, valid, values,
+                                        None, "str")
+            elif name in narrow:
+                codes, valid, enc = narrow[name]
+                plan[name] = WireColumn("enc", codes, valid, None, enc,
+                                        _t.device_kind(ct))
+            else:
+                data, valid = lower_plain_column(self.arrow[name], ct)
+                plan[name] = WireColumn("plain", data, valid, None, None,
+                                        _t.device_kind(ct))
+        return plan
+
     def padded_chunks(self):
         """Yield DeviceTable chunks at ONE uniform physical capacity
         (``chunk_cap``), the final partial chunk zero-padded up to it, with
@@ -228,13 +303,23 @@ class ChunkedTable:
         live prefix). Chunk k then differs from chunk j only in buffer
         CONTENTS — same shapes, same pytree structure, same dictionaries —
         which is what lets the compiled streaming executor drive every
-        chunk through a single traced program (engine/stream.py)."""
+        chunk through a single traced program (engine/stream.py).
+
+        With the persistent chunk store active (``NDS_TPU_CHUNK_STORE``)
+        the chunks slice pre-encoded whole-table wire arrays — possibly
+        memory-mapped from a previous run — instead of slicing arrow and
+        re-planning codecs; the store path produces bit-identical
+        buffers (same codecs, same lowering math)."""
         import jax.numpy as jnp
         import numpy as np
         from nds_tpu import types as _t
         from nds_tpu.engine.column import Column, from_arrow_array
         cap = self.chunk_cap
         n = self.arrow.num_rows
+        wire = self._wire_plan()
+        if wire is not None:
+            yield from self._padded_chunks_wire(wire, cap, n)
+            return
         strings = self._string_encodings()
         narrow = self._int_encodings()
         for s in (range(0, n, self.chunk_rows) if n else (0,)):
@@ -277,6 +362,31 @@ class ChunkedTable:
                 v = jnp.asarray(live_np) if c.valid is None else \
                     c.valid & jnp.asarray(live_np)
                 cols[name] = Column(c.kind, c.data, v, c.dict_values)
+            yield DeviceTable(cols, live, plen=cap)
+
+    def _padded_chunks_wire(self, wire: dict, cap: int, n: int):
+        """The store-backed twin of the inline ``padded_chunks`` body:
+        slice every column's whole-table wire array (codes / lowered
+        values, possibly mmapped) into zero-padded chunk buffers. Same
+        shapes, same dictionaries, same validity structure — a pipeline
+        compiled against either path serves the other."""
+        import jax.numpy as jnp
+        import numpy as np
+        from nds_tpu.engine.column import Column
+        for s in (range(0, n, self.chunk_rows) if n else (0,)):
+            live = min(self.chunk_rows, n - s) if n else 0
+            live_np = np.arange(cap) < live
+            cols = {}
+            for name in self.arrow.column_names:
+                wc = wire[name]
+                data = np.zeros(cap, dtype=wc.data.dtype)
+                data[:live] = wc.data[s:s + live]
+                v = live_np if wc.valid is None else \
+                    live_np & np.concatenate(
+                        [wc.valid[s:s + live],
+                         np.zeros(cap - live, dtype=bool)])
+                cols[name] = Column(wc.kind, jnp.asarray(data),
+                                    jnp.asarray(v), wc.values, wc.enc)
             yield DeviceTable(cols, live, plen=cap)
 
     def materialize(self) -> DeviceTable:

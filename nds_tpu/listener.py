@@ -150,6 +150,14 @@ class StreamEvent:
     kernel_fused_stages: int = -1  # fused stages per scan-pass launch
     #                            (lowered conjuncts + the routing-hash
     #                            stage); 0 = no fused scan pass ran
+    prefetch_stall_ms: float = -1.0  # driver milliseconds BLOCKED on the
+    #                            bounded prefetch ring (engine/prefetch)
+    #                            across the whole drive; with the ring
+    #                            off (NDS_TPU_PREFETCH_DEPTH=0) the
+    #                            inline slice+encode+upload time instead
+    #                            — the overlap win is this number
+    #                            shrinking, measured per scan, never
+    #                            asserted; -1 = unknown (old events)
 
 
 _stream_tls = threading.local()
@@ -161,7 +169,8 @@ def record_stream_event(where: str, chunks: int, syncs: int, path: str,
                         bytes_h2d: int = -1, shards: int = 1,
                         collectives: int = -1, bytes_ici: int = -1,
                         shard_rows=(), kernel_launches: int = -1,
-                        kernel_fused_stages: int = -1) -> None:
+                        kernel_fused_stages: int = -1,
+                        prefetch_stall_ms: float = -1.0) -> None:
     """Engine-side hook (engine/stream.py, sql/planner.py): record how a
     streamed scan executed. Thread-scoped like the sync counters, so
     concurrent Throughput streams account their own pipelines."""
@@ -173,7 +182,7 @@ def record_stream_event(where: str, chunks: int, syncs: int, path: str,
                            partitions, tuple(part_rows), bytes_h2d,
                            shards, collectives, bytes_ici,
                            tuple(shard_rows), kernel_launches,
-                           kernel_fused_stages))
+                           kernel_fused_stages, prefetch_stall_ms))
 
 
 def drain_stream_events() -> list:
@@ -205,6 +214,8 @@ def stream_event_json(e: StreamEvent) -> dict:
         **({"kernelLaunches": e.kernel_launches,
             "kernelStages": e.kernel_fused_stages}
            if e.kernel_launches > 0 else {}),
+        **({"prefetchStallMs": round(e.prefetch_stall_ms, 3)}
+           if e.prefetch_stall_ms >= 0 else {}),
         **({"reason": e.reason} if e.reason else {}),
     }
 

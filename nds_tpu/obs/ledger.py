@@ -248,6 +248,11 @@ def evidence_from_scans(scans) -> dict:
         ev["bytesH2d"] += max(s.get("bytesH2d", 0), 0)
         ev["bytesIci"] += max(s.get("bytesIci", 0), 0)
         ev["collectives"] += max(s.get("collectives", 0), 0)
+        # driver ms blocked on the prefetch ring (measured, non-
+        # deterministic — informational only, never a gated key)
+        ev["prefetchStallMs"] = round(
+            ev.get("prefetchStallMs", 0.0)
+            + max(s.get("prefetchStallMs", 0.0), 0.0), 3)
         ev["partitions"] = max(ev["partitions"], s.get("partitions", 1))
         ev["shards"] = max(ev["shards"], s.get("shards", 1))
         if s.get("path") == "compiled":

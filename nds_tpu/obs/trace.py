@@ -153,7 +153,7 @@ class SpanRecord:
     included — it is a tree, readers subtract for self-time)."""
 
     __slots__ = ("name", "attrs", "ts_ns", "dur_ns", "syncs",
-                 "sync_wait_ns", "compile_ns", "depth",
+                 "sync_wait_ns", "compile_ns", "depth", "dropped",
                  "_s0", "_w0", "_c0")
 
     def __init__(self, name: str, attrs: dict):
@@ -165,10 +165,19 @@ class SpanRecord:
         self.sync_wait_ns = 0
         self.compile_ns = 0
         self.depth = 0
+        self.dropped = False
 
     def set(self, **kw) -> None:
         """Attach counters/labels mid-span (chunks=…, cache="hit", …)."""
         self.attrs.update(kw)
+
+    def drop(self) -> None:
+        """Discard this span: it still unwinds normally at ``__exit__``
+        but is never emitted. For spans whose subject turns out not to
+        exist — e.g. the drive loop's ``stream.prefetch`` stall span
+        when the ring reports end-of-stream: there was no chunk, so
+        there must be no span record for one."""
+        self.dropped = True
 
     def __enter__(self) -> "SpanRecord":
         E = _ops()
@@ -192,7 +201,8 @@ class SpanRecord:
             st.pop()
         elif self in st:                  # defensive: mis-nested exits
             st.remove(self)
-        _emit(self)
+        if not self.dropped:
+            _emit(self)
         return False
 
     def __repr__(self):
@@ -208,6 +218,9 @@ class _NullSpan:
     __slots__ = ()
 
     def set(self, **kw) -> None:
+        pass
+
+    def drop(self) -> None:
         pass
 
     def __enter__(self) -> "_NullSpan":

@@ -1360,30 +1360,49 @@ class Planner:
                 if reason == "bound-bucket overflow" else "stream.eager"
             builds = [p for p in parts if isinstance(p, _OuterBuild)]
             bitmaps = None
+            # the eager loop pulls its chunks through the same bounded
+            # prefetch ring the compiled pipeline uses (engine/prefetch):
+            # the arrow slice + device conversion of chunk k+1 runs on
+            # the worker while chunk k's join graph executes here; depth
+            # 0 (NDS_TPU_PREFETCH_DEPTH=0) is the inline loop, bit for
+            # bit. The ring closes in the finally so a mid-loop planner
+            # exception never leaks the worker thread.
+            from nds_tpu.engine.prefetch import chunk_ring
+            ring = chunk_ring(parts[keep].device_chunks(self),
+                              name="nds-prefetch-eager")
             with _obs.span(eager_span,
                            reason=reason or "replay-nested"):
-                for chunk in parts[keep].device_chunks(self):
-                    n_chunks += 1
-                    # actual prefetch bytes of this scan (buffer metadata,
-                    # no sync): the eager loop uploads unencoded chunks
-                    h2d += sum(
-                        c.data.nbytes
-                        + (0 if c.valid is None else c.valid.nbytes)
-                        for c in chunk.columns.values())
-                    sub = list(parts)
-                    sub[keep] = chunk
-                    with E.outer_match_collector() as omc:
-                        out = self._join_parts(sub, join_preds,
-                                               where_conjuncts,
-                                               list(sources))
-                    if builds:
-                        # OR each chunk's matched-build-row masks: the
-                        # outer extras (unmatched across EVERY chunk)
-                        # append once, after the loop
-                        bitmaps = list(omc.masks) if bitmaps is None else \
-                            [a | b for a, b in zip(bitmaps, omc.masks)]
-                    if E.count_bound(out.nrows) or not outs:
-                        outs.append(out)
+                try:
+                    while True:
+                        chunk = ring.next_chunk()
+                        if chunk is None:
+                            break
+                        n_chunks += 1
+                        # actual prefetch bytes of this scan (buffer
+                        # metadata, no sync): the eager loop uploads
+                        # unencoded chunks
+                        h2d += sum(
+                            c.data.nbytes
+                            + (0 if c.valid is None else c.valid.nbytes)
+                            for c in chunk.columns.values())
+                        sub = list(parts)
+                        sub[keep] = chunk
+                        with E.outer_match_collector() as omc:
+                            out = self._join_parts(sub, join_preds,
+                                                   where_conjuncts,
+                                                   list(sources))
+                        if builds:
+                            # OR each chunk's matched-build-row masks:
+                            # the outer extras (unmatched across EVERY
+                            # chunk) append once, after the loop
+                            bitmaps = list(omc.masks) if bitmaps is None \
+                                else [a | b for a, b in zip(bitmaps,
+                                                            omc.masks)]
+                        if E.count_bound(out.nrows) or not outs:
+                            outs.append(out)
+                    stall_ms = ring.stall_ms()
+                finally:
+                    ring.close()
                 result = E.concat_tables(outs) if len(outs) > 1 else outs[0]
                 if builds and bitmaps is not None:
                     result = self._append_outer_extras(result, builds,
@@ -1396,10 +1415,12 @@ class Planner:
                 from nds_tpu.listener import record_stream_event
                 record_stream_event(parts[keep].alias, n_chunks,
                                     E.sync_count() - syncs0, "eager", reason,
-                                    bytes_h2d=h2d)
+                                    bytes_h2d=h2d,
+                                    prefetch_stall_ms=stall_ms)
                 from nds_tpu.engine.kernels import active_arm
                 _obs.annotate(path="eager", chunks=n_chunks, reason=reason,
-                              bytesH2d=h2d, kernelArm=active_arm(),
+                              bytesH2d=h2d, prefetchStallMs=stall_ms,
+                              kernelArm=active_arm(),
                               kernelLaunches=0, kernelStages=0)
             return result
 

@@ -1547,6 +1547,96 @@ def test_jax_lint_pallas_rule_baseline_untouched():
     assert not [f for f in fs if f.rule == "host-read-in-pallas"], fs
 
 
+def test_jax_lint_host_sync_in_prefetch_worker(tmp_path):
+    """Both directions of the host-sync-in-prefetch-worker rule: host
+    reads, engine sync entry points, a one-level-down syncing helper
+    and an obs.span inside a callable handed to the prefetch ring
+    (positional or prepare=, bare name or self.method) are errors; the
+    same calls outside any ring callable (or a clean prepare) are
+    not."""
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import ops
+        from nds_tpu.engine.prefetch import chunk_ring
+        from nds_tpu.obs import trace as obs
+
+        def _helper(x):
+            return ops.count_int(x.nrows)
+
+        def _prepare(chunk):
+            with obs.span("inner"):
+                pass
+            ops.host_read("tag", lambda: 1)
+            n = chunk.nrows.to_int()
+            _helper(chunk)
+            return chunk
+
+        def drive(chunks):
+            ring = chunk_ring(chunks, prepare=_prepare)
+            return ring
+    """, rel="nds_tpu/engine/other.py")
+    rules = [f.rule for f in fs]
+    assert rules == ["host-sync-in-prefetch-worker"] * 4, fs
+    assert all(f.severity == "error" for f in fs)
+    # self.method spelling + constructor form resolve too
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import ops
+        from nds_tpu.engine.prefetch import ChunkRing
+
+        class Pipe:
+            def _prep(self, chunk):
+                return ops.resolve_counts()
+
+            def run(self, chunks):
+                return ChunkRing(chunks, self._prep, depth=2)
+    """, rel="nds_tpu/engine/other.py")
+    assert [f.rule for f in fs] == ["host-sync-in-prefetch-worker"], fs
+    # the SOURCE iterator's generator body runs on the worker too: a
+    # call expression passed as the source resolves by its callee name
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import ops
+        from nds_tpu.engine.prefetch import chunk_ring
+
+        class Scan:
+            def device_chunks(self, planner):
+                for c in self.chunks:
+                    ops.host_sync(c.nrows)
+                    yield c
+
+            def drive(self, planner):
+                return chunk_ring(self.device_chunks(planner))
+    """, rel="nds_tpu/engine/other.py")
+    assert [f.rule for f in fs
+            if f.rule == "host-sync-in-prefetch-worker"] == \
+        ["host-sync-in-prefetch-worker"], fs
+    # clean prepare + syncs OUTSIDE the ring callable: no findings
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import ops
+        from nds_tpu.engine.prefetch import chunk_ring
+
+        def _prepare(chunk):
+            return tuple(chunk.columns.values())
+
+        def drive(chunks):
+            ring = chunk_ring(chunks, prepare=_prepare)
+            n = ops.count_int(4)          # outside: legal
+            return ring, n
+    """, rel="nds_tpu/engine/other.py")
+    assert not [f for f in fs
+                if f.rule == "host-sync-in-prefetch-worker"], fs
+
+
+def test_jax_lint_prefetch_rule_baseline_untouched():
+    """The shipped ring callables (engine/stream.py's prepare methods,
+    engine/prefetch.py itself, the planner's eager-loop ring) must be
+    clean under the new rule — the baseline gains nothing."""
+    from nds_tpu.analysis.jax_lint import lint_file
+    for rel in ("nds_tpu/engine/stream.py", "nds_tpu/engine/prefetch.py",
+                "nds_tpu/sql/planner.py"):
+        fs = lint_file(os.path.join(REPO, *rel.split("/")), rel)
+        assert not [f for f in fs
+                    if f.rule == "host-sync-in-prefetch-worker"], (rel, fs)
+
+
 def test_kernel_spec_eligibility_rule():
     """The shared eligibility rule (analysis/kernel_spec.py) on its
     canonical shapes — the ONE rule the runtime lowering and the static
@@ -1700,7 +1790,13 @@ def test_lint_changed_covers_kernels():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     for p in ("nds_tpu/engine/kernels.py",
-              "nds_tpu/analysis/kernel_spec.py"):
+              "nds_tpu/analysis/kernel_spec.py",
+              # async ingest data plane: the prefetch ring (admission
+              # pricing + worker lint contract) and the persistent
+              # chunk store (the streamed wire format) rerun the
+              # corpus passes on edit
+              "nds_tpu/engine/prefetch.py",
+              "nds_tpu/io/chunk_store.py"):
         assert p.startswith(mod._CORPUS_ROOTS), \
             f"{p} not covered by _CORPUS_ROOTS"
 

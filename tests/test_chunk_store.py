@@ -1,0 +1,193 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Persistent pre-encoded chunk store (io/chunk_store.py).
+
+Round-trip: a warm run must slice mmapped wire arrays into the SAME
+padded chunks (bit-for-bit query results) without touching arrow
+slicing or codec planning. Edges per the store contract: version gate
+and checksum mismatch REFUSED loudly (ChunkStoreError, never silently
+served), a stale codec plan (data changed under the same shape)
+INVALIDATES silently (miss -> re-encode -> overwrite), and empty /
+single-row tables round-trip.
+"""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from nds_tpu.engine.session import Session
+from nds_tpu.engine.table import ChunkedTable
+from nds_tpu.io import chunk_store as CS
+
+
+def _table(n=5000, seed=3, shift=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 50, n) + shift, pa.int64()),
+        "v": pa.array(rng.integers(0, 10_000, n), pa.int64()),
+        "s": pa.array([f"x{i % 7}" for i in range(n)], pa.string()),
+        "f": pa.array(rng.random(n), pa.float64()),
+    })
+
+
+_SQL = ("select k, s, count(*) c, sum(v) sv from t where v > 100 "
+        "group by k, s order by k, s")
+
+
+def _run(tbl, chunk_rows=800):
+    s = Session()
+    s.create_temp_view("t", ChunkedTable(tbl, chunk_rows=chunk_rows),
+                       base=True)
+    return s.sql(_SQL).collect()
+
+
+def _entry(root):
+    (e,) = [d for d in os.listdir(root) if not d.startswith(".")]
+    return os.path.join(root, e)
+
+
+def test_store_round_trip_bit_for_bit(tmp_path, monkeypatch):
+    """Cold run (build + persist), warm run (load + mmap), and the
+    store-off baseline must all produce identical rows; the warm run
+    must go through load_plan, not re-save."""
+    tbl = _table()
+    base = _run(tbl)
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
+    cold = _run(tbl)
+    entry = _entry(str(tmp_path))
+    manifest0 = open(os.path.join(entry, "manifest.json")).read()
+    saves = []
+    orig_save = CS.save_plan
+    monkeypatch.setattr(CS, "save_plan",
+                        lambda *a, **k: saves.append(1) or
+                        orig_save(*a, **k))
+    warm = _run(tbl)
+    assert cold == base == warm and base
+    assert not saves, "warm run re-encoded instead of loading the store"
+    assert open(os.path.join(entry, "manifest.json")).read() == manifest0
+
+
+def test_store_warm_run_skips_arrow_and_codec_planning(tmp_path,
+                                                       monkeypatch):
+    """The tentpole claim: a warm run never lowers from arrow and never
+    re-plans codecs or re-encodes dictionaries — padded_chunks serves
+    mmapped wire arrays only."""
+    from nds_tpu.engine import column as _column
+    from nds_tpu.io import columnar as _col
+    tbl = _table()
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
+    _run(tbl)                              # cold: build + persist
+
+    def _refuse(what):
+        def f(*a, **k):
+            raise AssertionError(f"warm store run called {what}")
+        return f
+
+    monkeypatch.setattr(_col, "plan_column_codec",
+                        _refuse("plan_column_codec (codec re-planning)"))
+    monkeypatch.setattr(_column, "from_arrow_array",
+                        _refuse("from_arrow_array (arrow chunk "
+                                "lowering)"))
+    monkeypatch.setattr(ChunkedTable, "_build_wire_plan",
+                        _refuse("_build_wire_plan (re-encode)"))
+    monkeypatch.setattr(ChunkedTable, "_string_encodings",
+                        _refuse("_string_encodings (dictionary "
+                                "re-encode)"))
+    got = _run(tbl)
+    assert got, "warm store run produced nothing"
+
+
+def test_store_version_gate_refused_loudly(tmp_path, monkeypatch):
+    tbl = _table()
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
+    _run(tbl)
+    mp = os.path.join(_entry(str(tmp_path)), "manifest.json")
+    m = json.load(open(mp))
+    m["version"] = CS.STORE_VERSION + 1
+    json.dump(m, open(mp, "w"))
+    with pytest.raises(CS.ChunkStoreError, match="layout version"):
+        _run(tbl)
+
+
+def test_store_checksum_mismatch_refused_loudly(tmp_path, monkeypatch):
+    tbl = _table()
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
+    _run(tbl)
+    entry = _entry(str(tmp_path))
+    (data0,) = [f for f in sorted(os.listdir(entry))
+                if f.endswith("000.data.npy")]
+    p = os.path.join(entry, data0)
+    with open(p, "r+b") as f:
+        f.seek(-1, 2)
+        b = f.read(1)
+        f.seek(-1, 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CS.ChunkStoreError, match="checksum mismatch"):
+        _run(tbl)
+
+
+def test_store_stale_codec_plan_invalidates(tmp_path, monkeypatch):
+    """Same shape, different DATA (shifted key domain => different FOR
+    base): the old entry must read as a miss, the query must re-encode
+    against the new data (correct results), and the entry on disk must
+    be overwritten with the new fingerprint."""
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
+    old = _table(shift=0)
+    _run(old)
+    entry = _entry(str(tmp_path))
+    fp_old = json.load(open(os.path.join(entry, "manifest.json")))[
+        "fingerprint"]
+    new = _table(shift=1000)               # same schema/rows, new values
+    monkeypatch.delenv("NDS_TPU_CHUNK_STORE")
+    expect = _run(new)                     # store-off truth
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
+    got = _run(new)
+    assert got == expect and got, \
+        "stale store entry served old codes for new data"
+    fp_new = json.load(open(os.path.join(entry, "manifest.json")))[
+        "fingerprint"]
+    assert fp_new != fp_old, "entry was not rewritten after data change"
+    assert _run(new) == expect             # and the new entry is warm
+
+
+def test_store_empty_and_single_row_tables(tmp_path, monkeypatch):
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
+    empty = _table(n=0)
+    one = _table(n=1, seed=9)
+    for tbl in (empty, one):
+        s = Session()
+        s.create_temp_view("t", ChunkedTable(tbl, chunk_rows=800),
+                           base=True)
+        cold = s.sql("select k, v, s from t order by k").collect()
+        s2 = Session()
+        s2.create_temp_view("t", ChunkedTable(tbl, chunk_rows=800),
+                            base=True)
+        warm = s2.sql("select k, v, s from t order by k").collect()
+        assert cold == warm
+        assert len(cold) == tbl.num_rows
+
+
+def test_store_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("NDS_TPU_CHUNK_STORE", raising=False)
+    assert CS.store_root() is None
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", "")
+    assert CS.store_root() is None         # empty = off
+    _run(_table(n=64))
+    assert not os.listdir(str(tmp_path))
+
+
+def test_store_and_ring_compose(tmp_path, monkeypatch):
+    """The warm store feeds the prefetch ring: mmapped wire arrays slice
+    inside the worker thread, results identical to the inline no-store
+    path at both depths."""
+    tbl = _table()
+    base = _run(tbl)
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
+    _run(tbl)                              # persist
+    for depth in ("0", "3"):
+        monkeypatch.setenv("NDS_TPU_PREFETCH_DEPTH", depth)
+        from nds_tpu.engine import stream
+        stream.reset_pipeline_cache()
+        assert _run(tbl) == base, f"store+ring divergence at depth {depth}"

@@ -136,11 +136,12 @@ def test_env_knobs_read_after_import(monkeypatch):
     """Every converted import-time snapshot now reads its env knob at
     build/use time — the set-after-import regression net (PR 6
     pattern). A knob set after import must be honored immediately."""
-    from nds_tpu.engine import kernels, ops, replay
+    from nds_tpu.engine import kernels, ops, prefetch, replay
     from nds_tpu.obs import trace
     from nds_tpu.sql import planner
 
     cases = [
+        ("NDS_TPU_PREFETCH_DEPTH", prefetch.prefetch_depth, "5", 5),
         ("NDS_TPU_PAIR_BUDGET", ops.pair_budget, "12345", 12345),
         ("NDS_TPU_GROUP_PACK_MIN", ops.group_pack_min, "777", 777),
         ("NDS_TPU_LAZY_SHRINK_ROWS", ops.lazy_shrink_rows, "4096", 4096),

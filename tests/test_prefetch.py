@@ -1,0 +1,381 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Async ingest data plane: bounded prefetch ring (engine/prefetch.py).
+
+The overlap claim is MEASURED, not asserted: a slow-source differential
+(chunk iterator with a deliberate per-chunk host delay) must show ring
+depth >= 1 strictly beating depth 0 wall clock, while every template of
+the ``test_synccount`` A/B sweep stays bit-for-bit identical between
+the two depths under strict mode + forced partitions, and the sharded
+subset under a forced 2-shard mesh. Plus the ring unit contract
+(ordering, backpressure, shutdown, exception propagation), the
+set-after-import env regression (PR 6/13 pattern), the pipeline-cache
+key membership of the depth knob, and the prefetch-span relabel (spans
+only for real fetches, labeled with the chunk they fetch).
+"""
+
+import contextlib
+import time
+
+import numpy as np
+import pytest
+
+from nds_tpu.engine import ops as E
+from nds_tpu.engine import prefetch as PF
+from nds_tpu.engine.table import ChunkedTable
+
+from test_synccount import (_STREAM_AB_PARTITIONED, _STREAM_AB_QUERIES,
+                            _STREAM_AB_SHARDED, _chunked_star_session,
+                            _forced_stream_partitions,
+                            _forced_stream_shards)
+
+
+@contextlib.contextmanager
+def _forced_depth(monkeypatch, depth):
+    from nds_tpu.engine import stream
+    monkeypatch.setenv("NDS_TPU_PREFETCH_DEPTH", str(depth))
+    stream.reset_pipeline_cache()
+    try:
+        yield
+    finally:
+        stream.reset_pipeline_cache()
+
+
+# ---------------------------------------------------------------------------
+# ring unit contract
+# ---------------------------------------------------------------------------
+
+
+def test_ring_ordered_delivery_and_end_of_stream():
+    ring = PF.ChunkRing(iter(range(100)), depth=3)
+    try:
+        got = [ring.next_chunk() for _ in range(100)]
+        assert got == list(range(100)), "delivery must preserve order"
+        assert ring.next_chunk() is None
+        assert ring.next_chunk() is None      # stable after end
+    finally:
+        ring.close()
+
+
+def test_ring_prepare_runs_off_driver_thread():
+    import threading
+    driver = threading.get_ident()
+    seen = []
+
+    def prepare(x):
+        seen.append(threading.get_ident())
+        return x * 2
+
+    ring = PF.ChunkRing(iter(range(8)), prepare=prepare, depth=2)
+    try:
+        assert [ring.next_chunk() for _ in range(8)] == \
+            [2 * i for i in range(8)]
+    finally:
+        ring.close()
+    assert seen and all(t != driver for t in seen), \
+        "prepare must run on the worker thread"
+
+
+def test_ring_worker_exception_propagates():
+    def src():
+        yield 1
+        yield 2
+        raise ValueError("simulated slice failure")
+
+    ring = PF.ChunkRing(src(), depth=2)
+    try:
+        assert ring.next_chunk() == 1
+        assert ring.next_chunk() == 2
+        with pytest.raises(ValueError, match="simulated slice failure"):
+            ring.next_chunk()
+    finally:
+        ring.close()
+
+
+def test_ring_backpressure_and_clean_shutdown():
+    pulled = []
+
+    def src():
+        for i in range(1000):
+            pulled.append(i)
+            yield i
+
+    ring = PF.ChunkRing(src(), depth=2)
+    try:
+        # settle: the worker must block at the bound, not run the
+        # thousand-item source dry
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            n = len(pulled)
+            time.sleep(0.05)
+            if len(pulled) == n:
+                break
+        assert len(pulled) <= 3, \
+            f"worker ran {len(pulled)} ahead (bound depth+1=3)"
+    finally:
+        ring.close()
+    assert not ring._thread.is_alive(), "close() must join the worker"
+    n_closed = len(pulled)
+    time.sleep(0.1)
+    assert len(pulled) == n_closed, "worker kept pulling after close()"
+
+
+def test_depth_zero_is_inline():
+    """Depth 0 must not spawn a thread: the inline pump is today's
+    path, bit for bit (and the escape hatch of the whole subsystem)."""
+    ran_on = []
+
+    def prepare(x):
+        import threading
+        ran_on.append(threading.get_ident())
+        return x
+
+    ring = PF.chunk_ring(iter(range(4)), prepare=prepare, depth=0)
+    import threading
+    assert isinstance(ring, PF._InlineRing)
+    assert [ring.next_chunk() for _ in range(5)] == [0, 1, 2, 3, None]
+    assert all(t == threading.get_ident() for t in ran_on)
+
+
+def test_prefetch_depth_env_read_after_import(monkeypatch):
+    """Set-after-import regression (the PR 6/13 env-knob pattern): the
+    depth knob must be read at ring-BUILD time, and flipping it must
+    switch between the threaded ring and the inline pump."""
+    monkeypatch.setenv("NDS_TPU_PREFETCH_DEPTH", "5")
+    assert PF.prefetch_depth() == 5
+    r = PF.chunk_ring(iter(()))
+    assert isinstance(r, PF.ChunkRing) and r._q.maxsize == 5
+    r.close()
+    monkeypatch.setenv("NDS_TPU_PREFETCH_DEPTH", "0")
+    assert PF.prefetch_depth() == 0
+    assert isinstance(PF.chunk_ring(iter(())), PF._InlineRing)
+    monkeypatch.delenv("NDS_TPU_PREFETCH_DEPTH")
+    assert PF.prefetch_depth() == 2      # default
+
+
+def test_depth_joins_pipeline_cache_key(monkeypatch):
+    """The depth shapes admission arithmetic (capacity − ring bytes),
+    which sizes compiled accumulator shapes — a depth change after a
+    compile must MISS, never serve the stale pipeline."""
+    from nds_tpu.engine import stream
+    q = _STREAM_AB_QUERIES[1][0]
+    with _forced_stream_partitions():
+        stream.reset_pipeline_cache()
+        s = _chunked_star_session(np.random.default_rng(5))
+        rows1 = s.sql(q).collect()
+        n1 = sum(stream.pipeline_build_counts().values())
+        assert n1 >= 1
+        rows_warm = s.sql(q).collect()
+        assert sum(stream.pipeline_build_counts().values()) == n1
+        monkeypatch.setenv("NDS_TPU_PREFETCH_DEPTH", "7")
+        rows2 = s.sql(q).collect()
+        assert sum(stream.pipeline_build_counts().values()) > n1, \
+            "depth change served the stale compiled pipeline"
+    assert rows1 == rows_warm == rows2
+
+
+# ---------------------------------------------------------------------------
+# the slow-source differential: overlap measured, results bit-for-bit
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _delayed_chunks(monkeypatch, delay_s):
+    """Wrap ChunkedTable.padded_chunks with a per-chunk host delay — the
+    stand-in for a slow disk / object-store read. The sleep runs inside
+    the generator, i.e. ON the prefetch worker when the ring is live and
+    inline on the driver when it is not."""
+    orig = ChunkedTable.padded_chunks
+
+    def slow(self):
+        for c in orig(self):
+            time.sleep(delay_s)
+            yield c
+
+    monkeypatch.setattr(ChunkedTable, "padded_chunks", slow)
+    try:
+        yield
+    finally:
+        monkeypatch.setattr(ChunkedTable, "padded_chunks", orig)
+
+
+def test_slow_source_differential_overlap_and_equality(monkeypatch):
+    """THE overlap proof: on a delayed chunk source, ring depth >= 1
+    must finish strictly below depth 0 wall (the worker produces chunk
+    k+1 while the driver compiles/dispatches chunk k), the two arms'
+    rows must be bit-for-bit identical, syncs must not move, and the
+    driver's measured blocked-on-ring time (prefetch_stall_ms evidence)
+    must shrink vs the inline arm's production time."""
+    from nds_tpu.listener import drain_stream_events
+    q = _STREAM_AB_QUERIES[0][0]            # flagship star join, 10 chunks
+    delay = 0.06
+    walls, rows, stalls, syncs = {}, {}, {}, {}
+    with _forced_stream_partitions():
+        for depth in (0, 2):
+            with _forced_depth(monkeypatch, depth):
+                s = _chunked_star_session(np.random.default_rng(42))
+                drain_stream_events()
+                with _delayed_chunks(monkeypatch, delay):
+                    before = E.sync_count()
+                    t0 = time.perf_counter()
+                    rows[depth] = s.sql(q).collect()
+                    walls[depth] = time.perf_counter() - t0
+                    syncs[depth] = E.sync_count() - before
+                (ev,) = drain_stream_events()
+                assert ev.path == "compiled", f"depth {depth} fell back"
+                assert ev.prefetch_stall_ms >= 0
+                stalls[depth] = ev.prefetch_stall_ms
+    assert rows[2] == rows[0] and rows[0], "ring changed the results"
+    assert syncs[2] == syncs[0], \
+        f"ring changed the sync count: {syncs}"
+    assert walls[2] < walls[0], \
+        (f"no overlap: depth 2 wall {walls[2]:.3f}s not below depth 0 "
+         f"wall {walls[0]:.3f}s (stalls {stalls})")
+    # the inline arm pays the full per-chunk production serially; the
+    # ring arm must hide a real fraction of it behind compile+dispatch
+    assert stalls[2] < stalls[0], \
+        f"driver stall did not shrink: {stalls}"
+
+
+def test_ab_sweep_bit_for_bit_across_depths(monkeypatch):
+    """Every template of the A/B sweep — multi-pass, partitioned,
+    subquery-chained, outer-deferred — must produce identical rows with
+    the ring on (depth 2) and off (depth 0), under strict mode + forced
+    partitions: thread-offloaded ingest must never reach the math. The
+    compiled path must hold at both depths, with partition evidence
+    intact."""
+    from nds_tpu.listener import drain_stream_events
+    got = {0: [], 2: []}
+    with _forced_stream_partitions() as n_parts:
+        for depth in (0, 2):
+            with _forced_depth(monkeypatch, depth):
+                s = _chunked_star_session(np.random.default_rng(42))
+                drain_stream_events()
+                for i, (q, must_stream) in enumerate(_STREAM_AB_QUERIES):
+                    got[depth].append(s.sql(q).collect())
+                    events = drain_stream_events()
+                    if must_stream:
+                        assert events and all(e.path == "compiled"
+                                              for e in events), \
+                            f"depth {depth} fell back on: {q}"
+                    if i in _STREAM_AB_PARTITIONED:
+                        (e,) = events
+                        assert e.partitions == n_parts, (depth, q, e)
+                        assert sum(e.part_rows) == e.rows
+    for (q, _), a, b in zip(_STREAM_AB_QUERIES, got[2], got[0]):
+        assert a == b, f"ring on/off divergence on: {q}"
+        assert a, f"A/B template unexpectedly empty: {q}"
+
+
+def test_sharded_sweep_bit_for_bit_across_depths(monkeypatch):
+    """The sharded subset under a forced 2-shard mesh: the worker-side
+    row-sharded placement (each shard's slice device_put on its own
+    device inside the prefetch worker) must be bit-for-bit identical to
+    the inline sharded upload, shard evidence intact."""
+    import jax
+    from test_synccount import _STREAM_AB_SHARD_COUNT
+    if len(jax.local_devices()) < _STREAM_AB_SHARD_COUNT:
+        pytest.skip("needs a multi-device (virtual) mesh")
+    from nds_tpu.listener import drain_stream_events
+    got = {0: {}, 2: {}}
+    with _forced_stream_partitions():
+        with _forced_stream_shards() as n_shards:
+            for depth in (0, 2):
+                with _forced_depth(monkeypatch, depth):
+                    s = _chunked_star_session(np.random.default_rng(42))
+                    drain_stream_events()
+                    for i in _STREAM_AB_SHARDED:
+                        q, _must = _STREAM_AB_QUERIES[i]
+                        got[depth][i] = s.sql(q).collect()
+                        events = drain_stream_events()
+                        assert events and all(e.path == "compiled"
+                                              for e in events), \
+                            f"depth {depth} sharded arm fell back: {q}"
+                        for e in events:
+                            assert e.shards == n_shards
+                            assert sum(e.shard_rows) == e.rows
+    for i in _STREAM_AB_SHARDED:
+        q, _ = _STREAM_AB_QUERIES[i]
+        assert got[2][i] == got[0][i], \
+            f"sharded ring on/off divergence on: {q}"
+        assert got[2][i], f"sharded template unexpectedly empty: {q}"
+
+
+def test_eager_loop_rides_the_ring(monkeypatch):
+    """The eager chunk loop (NDS_TPU_STREAM_EXEC=eager) consumes from
+    the same ring: identical rows at depth 0 and 2, the eager
+    StreamEvent carries the stall evidence."""
+    from nds_tpu.listener import drain_stream_events
+    q = _STREAM_AB_QUERIES[2][0]
+    monkeypatch.setenv("NDS_TPU_STREAM_EXEC", "eager")
+    rows = {}
+    for depth in (0, 2):
+        with _forced_depth(monkeypatch, depth):
+            s = _chunked_star_session(np.random.default_rng(42))
+            drain_stream_events()
+            rows[depth] = s.sql(q).collect()
+            (ev,) = drain_stream_events()
+            assert ev.path == "eager"
+            assert ev.prefetch_stall_ms >= 0
+    assert rows[2] == rows[0] and rows[0]
+
+
+# ---------------------------------------------------------------------------
+# prefetch-span relabel: spans only for real fetches
+# ---------------------------------------------------------------------------
+
+
+def test_prefetch_spans_only_for_real_fetches():
+    """The drive loop emits one stream.prefetch span per chunk actually
+    FETCHED from the ring (chunks 1..N-1; chunk 0 is converted by the
+    record phase before the loop), labeled with that chunk's index —
+    and NO span for the end-of-stream probe that returns None (the old
+    mislabel recorded a phantom chunk N)."""
+    from nds_tpu.listener import drain_stream_events
+    from nds_tpu.obs import trace as obs_trace
+    with _forced_stream_partitions():
+        s = _chunked_star_session(np.random.default_rng(42))
+        drain_stream_events()
+        obs_trace.drain_spans()
+        s.sql(_STREAM_AB_QUERIES[1][0]).collect()
+        (ev,) = drain_stream_events()
+        assert ev.path == "compiled"
+        records = obs_trace.drain_spans()
+    pf = [r for r in records if isinstance(r, obs_trace.SpanRecord)
+          and r.name == "stream.prefetch"]
+    n = ev.chunks
+    assert len(pf) == n - 1, \
+        f"{len(pf)} prefetch spans for {n} chunks (want n-1 real fetches)"
+    assert [r.attrs.get("chunk") for r in pf] == list(range(1, n)), \
+        "prefetch spans must be labeled with the chunk they fetch"
+
+
+def test_trace_report_prefetch_stall_column(tmp_path):
+    """tools/trace_report.py prices the driver's blocked-on-ring time as
+    its own column, fed by the stream span's prefetchStallMs annotation
+    (the StreamEvent.prefetch_stall_ms evidence)."""
+    import importlib.util
+    import os as _os
+
+    from nds_tpu.listener import drain_stream_events
+    from nds_tpu.obs import export as obs_export
+    from nds_tpu.obs import trace as obs_trace
+    with _forced_stream_partitions():
+        s = _chunked_star_session(np.random.default_rng(42))
+        drain_stream_events()
+        obs_trace.drain_spans()
+        s.sql(_STREAM_AB_QUERIES[0][0]).collect()
+        (ev,) = drain_stream_events()
+        assert ev.prefetch_stall_ms >= 0
+        records = obs_trace.drain_spans()
+    tdir = tmp_path / "traces"
+    tdir.mkdir()
+    obs_export.write_chrome_trace(str(tdir / "q.trace.json"), records,
+                                  query="q")
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_report_pf", _os.path.join(repo, "tools",
+                                         "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = "\n".join(mod.report(str(tdir)))
+    assert "pf-stall ms" in out, out

@@ -30,6 +30,16 @@ and fails when the shared-state contract is violated:
   removed (or no-op'd) the worker's mutation lands inside the hold
   window.
 
+* **ring-liveness probe** — the bounded prefetch ring
+  (``engine/prefetch.py``) under real threads, every leg deterministic
+  (event-gated, no sleeps-as-synchronization): a stalled consumer must
+  BOUND the worker (backpressure: the source is never pulled more than
+  ``depth + 1`` items ahead), consuming one item releases exactly one
+  more pull, ``close()`` mid-stream joins the worker and stops
+  production, delivery stays ordered, end-of-stream yields None
+  exactly once, and a raising source PROPAGATES at the next fetch
+  instead of wedging the driver.
+
 ``--inject-drift`` monkeypatches each named lock (or ``--lock NAME``,
 one) to a no-op context manager and reruns the probes — every injection
 MUST be caught, proving the harness can detect a dropped or dead lock
@@ -465,6 +475,114 @@ def probe_lock(name, lock, observe, mutate, hold_s=_PROBE_HOLD_S):
     return problems
 
 
+def run_ring_probe(lines=None, depth=2):
+    """Liveness/boundedness probe of the bounded prefetch ring
+    (``engine/prefetch.py``) under real threads. Deterministic: every
+    transition is gated on an Event the source iterator itself sets, so
+    a pass never depends on scheduler luck. Returns (ok, lines)."""
+    from nds_tpu.engine.prefetch import ChunkRing
+
+    lines = [] if lines is None else lines
+    problems = []
+
+    pulled = []                       # items the worker pulled so far
+    pull_evt = threading.Event()      # set on every source pull
+
+    def source(n=64):
+        for i in range(n):
+            pulled.append(i)
+            pull_evt.set()
+            yield i
+
+    def settle():
+        """Wait until pulls quiesce: done when a full wait window
+        passes with no new pull — the worker is BLOCKED at the bound,
+        not merely slow (deterministic: no scheduler luck)."""
+        for _ in range(200):
+            before = len(pulled)
+            pull_evt.clear()
+            if not pull_evt.wait(timeout=0.05) and len(pulled) == before:
+                return
+
+    ring = ChunkRing(source(), depth=depth, name="ring-probe")
+    try:
+        # backpressure: with nothing consumed, the worker must stall at
+        # the bound — depth items queued plus the one blocked in put
+        settle()
+        if len(pulled) > depth + 1:
+            problems.append(
+                f"worker ran {len(pulled)} items ahead with nothing "
+                f"consumed (bound is depth+1 = {depth + 1}): the ring "
+                "is not applying backpressure")
+        # consuming one item must release exactly one more pull
+        got0 = ring.next_chunk()
+        pull_evt.clear()
+        if not pull_evt.wait(timeout=10.0):
+            problems.append("consuming one item released no further "
+                            "pull: the worker wedged under backpressure")
+        if got0 != 0:
+            problems.append(f"out-of-order delivery: first item {got0}")
+        # ordered delivery of the next few
+        nxt = [ring.next_chunk() for _ in range(3)]
+        if nxt != [1, 2, 3]:
+            problems.append(f"out-of-order delivery: {nxt}")
+        # clean mid-stream shutdown: settle FIRST (the worker owes up
+        # to depth legitimate refill pulls for the items just consumed
+        # — reading the counter mid-refill would flag a correct ring),
+        # then close and require production to stop at the bound
+        settle()
+        n_at_close = len(pulled)
+        ring.close()
+        if ring._thread.is_alive():
+            problems.append("close() left the worker thread alive")
+        pull_evt.clear()
+        if pull_evt.wait(timeout=0.2) or len(pulled) > n_at_close + 1:
+            problems.append("worker kept pulling after close(): the "
+                            "shutdown signal is not honored")
+    finally:
+        ring.close()
+
+    # end-of-stream: exactly one None, then stable
+    r2 = ChunkRing(iter(range(3)), depth=depth, name="ring-probe-eos")
+    try:
+        got = [r2.next_chunk() for _ in range(5)]
+        if got != [0, 1, 2, None, None]:
+            problems.append(f"end-of-stream contract broken: {got}")
+    finally:
+        r2.close()
+
+    # worker-exception propagation: the driver must see the original
+    # error at the fetch, not a hang or a silent truncation
+    def bad_source():
+        yield 0
+        raise RuntimeError("ring-probe source failure")
+
+    r3 = ChunkRing(bad_source(), depth=depth, name="ring-probe-err")
+    try:
+        first = r3.next_chunk()
+        try:
+            r3.next_chunk()
+            problems.append("worker exception was swallowed (fetch "
+                            "returned instead of raising)")
+        except RuntimeError as exc:
+            if "ring-probe source failure" not in str(exc):
+                problems.append(f"wrong exception propagated: {exc}")
+        if first != 0:
+            problems.append(f"pre-error item corrupted: {first}")
+    finally:
+        r3.close()
+
+    ok = not problems
+    if ok:
+        lines.append("ok ring probe :: backpressure bounded at "
+                     f"depth+1={depth + 1}, ordered, clean shutdown, "
+                     "exception propagated")
+    else:
+        lines.append("MISMATCH ring probe")
+        lines.extend(f"    {p}" for p in problems)
+    return ok, lines
+
+
 def run_probes(only=None, lines=None):
     """Run the lock-liveness probes; returns (ok, lines)."""
     lines = [] if lines is None else lines
@@ -559,7 +677,8 @@ def run_diff():
             f"{sum(fuse_builds.values())} fused trace(s), identical rows")
 
     ok_p, lines = run_probes(lines=lines)
-    return ok and ok_p, lines
+    ok_r, lines = run_ring_probe(lines=lines)
+    return ok and ok_p and ok_r, lines
 
 
 def main(argv=None) -> int:

@@ -106,10 +106,18 @@ def git_changed_files():
 # kernel edits rerun the corpus passes. Named explicitly even though
 # the nds_tpu/engine prefix already covers it: the kernel-edit contract
 # is load-bearing for the lockstep gate, not an accident of prefixing.
+# nds_tpu/engine/prefetch.py (same explicit-naming rationale) holds the
+# bounded prefetch ring whose live set mem_audit prices into admission
+# and whose worker contract the host-sync-in-prefetch-worker rule
+# polices; nds_tpu/io/chunk_store.py holds the persistent wire format
+# the streamed chunks upload — codec-layout edits there rerun the
+# corpus passes like any other engine-semantics change.
 _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/engine", "nds_tpu/engine/kernels.py",
+                 "nds_tpu/engine/prefetch.py",
                  "nds_tpu/schema.py",
                  "nds_tpu/listener.py", "nds_tpu/io/columnar.py",
+                 "nds_tpu/io/chunk_store.py",
                  "nds_tpu/parallel/", "nds_tpu/obs/")
 
 

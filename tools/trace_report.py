@@ -14,7 +14,9 @@ Reads every ``*.trace.json`` a driver wrote (``nds_power.py --trace-dir``
    actually-uploaded (encoded) bytes per template plus the effective
    scan GB/s, and for sharded runs the ICI MB the explicit collectives
    moved plus the effective ICI GB/s (wire bytes over the collective
-   phase wall) — wins measured, not asserted;
+   phase wall), and the prefetch-stall column — driver ms BLOCKED on
+   the bounded prefetch ring (``StreamEvent.prefetch_stall_ms``), the
+   async-ingest overlap evidence — wins measured, not asserted;
 2. the top sync-charging host-read sites across the run (the first-class
    ``ops.host_read`` call-site tags — which engine lines pay the round
    trips);
@@ -158,13 +160,18 @@ def collect_from_traces(trace_dir):
         spans = self_times([e for e in events if not is_sync(e)])
         row = {"total_ms": 0.0, "syncs": 0, "phases": defaultdict(float),
                "h2d": 0, "logical": 0, "stream_ms": 0.0, "ici": 0,
-               "sync_ms": 0.0}
+               "sync_ms": 0.0, "pf_stall": 0.0}
         for e in spans:
             name = e["name"]
             args = e.get("args") or {}
             row["phases"][name if name in PHASES else "other"] += \
                 e["self"] / 1e3
             if name == "stream":
+                # driver ms BLOCKED on the prefetch ring, measured per
+                # scan (StreamEvent.prefetch_stall_ms riding the stream
+                # span annotation) — the async-ingest overlap evidence
+                row["pf_stall"] += max(args.get("prefetchStallMs", 0)
+                                       or 0, 0)
                 arm = args.get("kernelArm")
                 if arm:
                     ka = agg["kernel_arms"][query][arm]
@@ -234,7 +241,7 @@ def collect_from_ledger(path):
         row = {"total_ms": rec.get("ms", 0.0), "syncs": 0,
                "phases": defaultdict(float), "h2d": 0, "logical": 0,
                "stream_ms": 0.0, "ici": 0,
-               "sync_ms": rec.get("syncWaitMs", 0.0)}
+               "sync_ms": rec.get("syncWaitMs", 0.0), "pf_stall": 0.0}
         # rollup phase times are INCLUSIVE, so the umbrella spans —
         # 'query' (wraps everything) and 'stream' (wraps the chunk
         # pipeline) — must not fold into columns next to their own
@@ -270,6 +277,7 @@ def collect_from_ledger(path):
         row["h2d"] = max(ev.get("bytesH2d", 0), 0)
         row["logical"] = row["h2d"]
         row["ici"] = max(ev.get("bytesIci", 0), 0)
+        row["pf_stall"] = max(ev.get("prefetchStallMs", 0.0), 0.0)
         row["syncs"] = rec.get("hostSyncs",
                                sum(p.get("syncs", 0)
                                    for p in phases.values()))
@@ -349,15 +357,22 @@ def render(agg, source, top=10):
         used.append("other")
     any_bytes = any(r["logical"] for r in per_query.values())
     any_ici = any(r["ici"] for r in per_query.values())
+    # prefetch-stall column (StreamEvent.prefetch_stall_ms evidence):
+    # driver ms blocked on the bounded prefetch ring — present whenever
+    # any query carried the measurement (>= 0 means measured; the
+    # collectors clamp unknown/-1 to absent)
+    any_stall = any(r.get("pf_stall", 0.0) > 0.0
+                    for r in per_query.values())
     byte_heads = (" logical MB | h2d MB | eff GB/s | %HBM roof |"
                   if any_bytes else "")
     ici_heads = " ici MB | ici GB/s | %ICI roof |" if any_ici else ""
+    stall_heads = " pf-stall ms |" if any_stall else ""
     n_cols = (len(used) + 3 + (4 if any_bytes else 0)
-              + (3 if any_ici else 0))
+              + (3 if any_ici else 0) + (1 if any_stall else 0))
     lines = [f"# trace report: {len(per_query)} queries from {source}",
              "",
              "| query | total ms | " + " | ".join(used) +
-             " | host syncs |" + byte_heads + ici_heads,
+             " | host syncs |" + byte_heads + ici_heads + stall_heads,
              "|---" * n_cols + "|"]
     for q in sorted(per_query):
         r = per_query[q]
@@ -380,6 +395,8 @@ def render(agg, source, top=10):
             igbs = (r["ici"] / (coll_ms / 1e3) / 1e9) if coll_ms else 0.0
             tail += (f" {r['ici'] / 1e6:.1f} | {igbs:.2f} | "
                      f"{igbs / ROOFLINE_ICI_GBS * 100:.1f} |")
+        if any_stall:
+            tail += f" {r.get('pf_stall', 0.0):.1f} |"
         lines.append(f"| {q} | {r['total_ms']:.1f} | {cells} | "
                      f"{r['syncs']} |" + tail)
     comp = sum(r["phases"].get("stream.compile", 0.0)
