@@ -72,7 +72,8 @@ SETUP_TIMEOUT_S = float(os.environ.get("NDS_BENCH_SETUP_TIMEOUT_S", "300"))
 # columns + evidence) — ONE list, shared by the live loop and the resume
 # loader so a resumed campaign regenerates an identical PERF.md
 PERF_KEYS = ("hostSyncs", "syncWaitMs", "scanBytes", "scanGBps", "warmS",
-             "compileS", "streamedScans", "tracePhases", "evidence")
+             "compileS", "streamedScans", "tracePhases", "evidence",
+             "faultEvents")
 
 def ledger_mod():
     """nds_tpu/obs/ledger.py imported BY FILE PATH (shared helper): the
@@ -81,6 +82,46 @@ def ledger_mod():
     attachment belongs to the serving child alone)."""
     from tools._ledger_load import ledger_mod as _lm
     return _lm()
+
+
+def faults_mod():
+    """The fault registry (engine/faults.py, stdlib-only), by file path
+    via the ledger loader — the ``bench-child`` seam and the restart
+    backoff policy live against it without touching jax."""
+    return ledger_mod()._faults_mod()
+
+
+def restart_backoff_s(restart_n: int) -> float:
+    """Deterministic-JITTERED backoff before child restart ``restart_n``
+    (2nd start onwards): exponential base (NDS_BENCH_RESTART_BACKOFF_S,
+    default 1.0) with a hash-derived jitter fraction so co-scheduled
+    campaigns against one flaky backend don't restart in lockstep —
+    deterministic per restart index, so tests and wall bounds hold. The
+    2-strike setup circuit breaker still bounds the total: backoff
+    spaces the retries the breaker allows, it never extends them."""
+    try:
+        base = float(os.environ.get("NDS_BENCH_RESTART_BACKOFF_S", "1.0"))
+    except ValueError:
+        base = 1.0
+    if base <= 0 or restart_n <= 1:
+        return 0.0
+    raw = base * (2 ** min(restart_n - 2, 4))
+    jitter = ((restart_n * 2654435761) % 1000) / 1000.0  # [0, 1)
+    return min(raw * (1.0 + 0.5 * jitter), 30.0)
+
+
+def drain_parent_faults(ledger):
+    """Drain the PARENT-process fault ring into ledger progress notes:
+    the ``bench-child`` seam records its degrade events in THIS process
+    (the child is the thing that failed), so without a parent-side drain
+    that evidence would die in the ring instead of reaching the
+    campaign ledger. Returns the drained events either way."""
+    F = faults_mod()
+    events = F.drain_fault_events()
+    if ledger is not None:
+        for e in events:
+            ledger.progress(note="fault-event", **F.fault_event_json(e))
+    return events
 
 
 def ensure_data():
@@ -298,6 +339,17 @@ def run_server():
                 result["streamedScans"] = [
                     stream_event_json(e) for e in stream_events]
                 result["evidence"] = stream_evidence(stream_events)
+            # fault-recovery evidence (engine/faults.py): every seam
+            # recovery since the previous query — retries, degradation
+            # ladder steps, watchdog timeouts — next to streamedScans,
+            # so a fallback that fired in production is benchmark
+            # evidence, not log noise
+            from nds_tpu.engine.faults import (drain_fault_events,
+                                               fault_event_json)
+            fault_events = drain_fault_events()
+            if fault_events:
+                result["faultEvents"] = [fault_event_json(e)
+                                         for e in fault_events]
             if trace_records:
                 # per-phase attribution of the final timed pass (obs
                 # layer; zero added syncs): plan vs drive vs materialize
@@ -329,9 +381,27 @@ def run_server():
                       file=sys.stderr)
             print(json.dumps(result), flush=True)
         except Exception as e:                        # keep serving
-            print(json.dumps({"name": name,
-                              "error": f"{type(e).__name__}: {e}"[:300]}),
-                  flush=True)
+            print(json.dumps(error_result(name, e)), flush=True)
+
+
+def error_result(name, exc):
+    """The serving loop's one failure-path result line (child side,
+    engine loaded): classified status plus THIS query's drained fault
+    evidence — left in the thread ring, a failed query's events (incl.
+    the watchdog's `timeout`) would misattribute to the NEXT query's
+    drain on the success path."""
+    from nds_tpu.engine.faults import (StatementTimeout,
+                                       drain_fault_events,
+                                       fault_event_json)
+    out = {"name": name, "error": f"{type(exc).__name__}: {exc}"[:300]}
+    fault_events = drain_fault_events()
+    if fault_events:
+        out["faultEvents"] = [fault_event_json(ev) for ev in fault_events]
+    if isinstance(exc, StatementTimeout):
+        # the statement watchdog fired: the parent marks the query
+        # `timeout` (its classified status), not `error`
+        out["timeout"] = True
+    return out
 
 
 def _geomean(vals):
@@ -395,6 +465,16 @@ class ChildServer:
 
     def start(self, deadline_left):
         self.stop()
+        F = faults_mod()
+        try:
+            # bench-child seam (transient): an injected start fault
+            # takes the same path as a real setup failure — the caller's
+            # backoff + 2-strike circuit breaker own the recovery
+            F.fault_point("bench-child")
+        except F.FaultInjected as exc:
+            F.record_fault_event("bench-child", "degrade",
+                                 detail=str(exc)[:200])
+            return None
         self.proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--serve"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
@@ -662,7 +742,18 @@ def run_parent(t_entry):
                 if restarts > 6:                      # crash-looping backend
                     break
                 restarts += 1
+                # jittered backoff BETWEEN restarts (2nd start onwards):
+                # a crashing backend gets breathing room instead of an
+                # immediate hammer, before the 2-strike breaker trips
+                back = min(restart_backoff_s(restarts), max(left(), 0.0))
+                if back > 0:
+                    print(f"# child restart {restarts}: backing off "
+                          f"{back:.1f}s", file=sys.stderr)
+                    time.sleep(back)
                 ready = child.start(left())
+                # bench-child seam evidence (an injected or real start
+                # fault) lands in the parent's own ring — ledger it now
+                drain_parent_faults(ledger)
                 if ready is None:
                     # circuit breaker: BENCH_r05 burned its whole 3000s
                     # budget on six consecutive 300s setup timeouts against
@@ -738,9 +829,15 @@ def run_parent(t_entry):
                 print(f"# {name} failed: {msg.get('error')}",
                       file=sys.stderr)
                 if ledger is not None:
-                    ledger.query(name, status="error",
-                                 error=str(msg.get("error"))[:300],
-                                 attempt=attempts[name])
+                    # an in-process watchdog expiry (StatementTimeout)
+                    # is a classified `timeout`, not an `error`: the
+                    # statement was marked, the child kept serving
+                    status = "timeout" if msg.get("timeout") else "error"
+                    rec = {"error": str(msg.get("error"))[:300],
+                           "attempt": attempts[name]}
+                    if msg.get("faultEvents"):
+                        rec["faultEvents"] = msg["faultEvents"]
+                    ledger.query(name, status=status, **rec)
     finally:
         child.stop()
         if heartbeat is not None:

@@ -128,6 +128,14 @@ ENTRY_POINTS = (
     # stays at zero findings; the runtime half is conc_audit_diff's
     # ring-liveness probe.
     ("engine/prefetch.py", ""),
+    # the fault registry + recovery layer: fault_point/with_retry run on
+    # every thread (drivers, ring workers, watchdog helpers). Its shared
+    # state is exactly three things — the occurrence counters (ONE dict
+    # under the dedicated _FAULT_LOCK), the FaultEvent ring
+    # (thread-local deque(maxlen)), and the statement clock
+    # (thread-local) — so the inventory stays at zero findings; the
+    # runtime half is tools/fault_diff.py's injection matrix.
+    ("engine/faults.py", ""),
     ("listener.py", "record_stream_event"),
     ("listener.py", "drain_stream_events"),
     ("listener.py", "report_task_failure"),
@@ -204,6 +212,25 @@ _PIPELINE_EXEMPT = {
     "decides whether wire files are verified before the mmap, never "
     "what the buffers contain — same bit-identical-buffers argument "
     "as NDS_TPU_CHUNK_STORE",
+    "NDS_TPU_FAULT": "deterministic fault injection (engine/faults.py): "
+    "an injected build fault PREVENTS the cache entry (the build "
+    "raises/degrades), and a non-injected build bakes nothing of the "
+    "knob into the program — the knob can never stale a compiled "
+    "pipeline; tools/fault_diff.py additionally resets the pipeline "
+    "cache around every injected run",
+    "NDS_TPU_FAULT_HANG_S": "injection timing only (how long a "
+    "hang-kind fault blocks before raising): never reaches a compiled "
+    "program's values",
+    "NDS_TPU_FAULT_DRIFT": "harness-only recovery suppression for the "
+    "--inject-drift self-test: changes whether a retry happens, never "
+    "what a successful build compiles",
+    "NDS_TPU_STATEMENT_DEADLINE_S": "watchdog timing only: decides WHEN "
+    "a hung blocking read raises StatementTimeout, never what a "
+    "completed read returns — a timed-out statement produces no result "
+    "to cache",
+    "NDS_TPU_CHUNK_STORE_LOCK_STALE_S": "writer-lock steal age of the "
+    "chunk store: write-side contention policy, never the wire bytes "
+    "(same bit-identical-buffers argument as NDS_TPU_CHUNK_STORE)",
 }
 
 CACHE_REGISTRY = {

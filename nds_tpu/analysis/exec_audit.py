@@ -116,6 +116,24 @@ counters would swallow it), and at runtime by the same span/event sync
 cross-checks the differential harness already runs (a worker sync would
 surface as an event-vs-bound mismatch).
 
+**Fault-recovery retries RE-CHARGE the same bound, never re-budget it.**
+The fault-tolerance layer (``engine/faults.py``, DESIGN.md
+"Fault-tolerance contract") wraps every blocking device->host fetch in
+a bounded transient retry (``sync`` seam) and may degrade a compiled
+pipeline to the eager loop (``pipeline-compile``/``exchange`` seams).
+The sync model here bounds the FAULT-FREE run: a transient retry
+re-executes the SAME charged read (attempt k pays the identical sync
+the model already counted once — under fault the realized count is
+bound × attempts, bounded by the seam's registered retry allowance,
+never unbounded), and a degradation lands on the eager path whose
+O(chunks) cost the model already reports per scan. Neither moves a
+classification or a bound in this module; both are evidence-recorded
+as FaultEvents, so ``tools/fault_diff.py`` can subtract recoveries
+when holding runtime evidence against the static bounds — a recovered
+run must still be bit-for-bit, and an unrecovered one must raise a
+classified error within its deadline rather than drift past the model
+silently.
+
 **Trace instrumentation is sync-free.** The obs span layer
 (:mod:`nds_tpu.obs`) wraps the instrumented phases in host-clock spans
 that read only the thread's existing sync/wait/compile counters, so the

@@ -86,6 +86,17 @@ with ``# nds-lint: ignore[rule]`` on the flagged line or the line above):
   local), one level down into module-local helpers. Error severity —
   the worker's zero-sync contract is what lets ingest leave the driver
   thread at all.
+* ``swallowed-fault`` — an ``except`` handler that catches one of the
+  fault layer's classified errors (``FaultError`` / ``FaultInjected`` /
+  ``StatementTimeout``, bare or attribute-qualified) whose body neither
+  records a :class:`nds_tpu.engine.faults.FaultEvent`
+  (``record_fault_event(...)``) nor re-raises. A recovery path that
+  absorbs a classified fault silently breaks the fault-tolerance
+  contract's evidence rule (DESIGN.md "Fault-tolerance contract"):
+  ``tools/fault_diff.py`` proves FaultEvent counts match injections
+  exactly, so a swallowed fault is an un-auditable fallback — exactly
+  the failure-as-log-noise pattern the registry exists to end. Error
+  severity.
 * ``chunk-loop-host-sync`` — a host-sync primitive (``.item()``,
   ``np.asarray``/``np.array``, ``device_get``, ``.to_int()``, or the
   engine's ``host_sync``/``count_int``/``resolve_counts``) lexically
@@ -119,6 +130,11 @@ _ENGINE_SYNC_FUNCS = {"host_sync", "count_int", "resolve_counts"}
 # ops.host_read-charging entry points (every counted device->host read
 # funnels through host_read; these are the call forms code reaches it by)
 _HOST_READ_FUNCS = {"host_read", "timed_read", "guarded_scalar_read"}
+# the fault layer's classified error types (engine/faults.py): a handler
+# catching one must record a FaultEvent or re-raise (swallowed-fault)
+_FAULT_ERROR_NAMES = {"FaultError", "FaultInjected", "StatementTimeout"}
+# the recorder call forms a compliant handler may use
+_FAULT_RECORD_FUNCS = {"record_fault_event"}
 
 
 def _sync_primitive(node) -> str | None:
@@ -430,6 +446,51 @@ class _Lint(ast.NodeVisitor):
         self.loop_depth += 1
         self.generic_visit(node)
         self.loop_depth -= 1
+
+    # -- fault-layer recovery paths -----------------------------------------
+
+    def visit_Try(self, node):
+        for h in node.handlers:
+            if h.type is not None and self._catches_fault_error(h.type):
+                if not self._handler_records_or_raises(h):
+                    self._emit(
+                        "swallowed-fault", "error",
+                        "except clause catches a classified fault "
+                        "(FaultError family) but neither records a "
+                        "FaultEvent (record_fault_event) nor re-raises "
+                        "— recovery paths must stay auditable "
+                        "(DESIGN.md 'Fault-tolerance contract')",
+                        h.lineno)
+        self.generic_visit(node)
+
+    visit_TryStar = visit_Try
+
+    @staticmethod
+    def _catches_fault_error(type_expr) -> bool:
+        """Does the handler's type expression name one of the fault
+        layer's classified errors (bare, attribute-qualified, or inside
+        a tuple)?"""
+        for n in ast.walk(type_expr):
+            if isinstance(n, ast.Name) and n.id in _FAULT_ERROR_NAMES:
+                return True
+            if isinstance(n, ast.Attribute) and \
+                    n.attr in _FAULT_ERROR_NAMES:
+                return True
+        return False
+
+    @staticmethod
+    def _handler_records_or_raises(handler) -> bool:
+        for stmt in handler.body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Raise):
+                    return True
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    name = f.id if isinstance(f, ast.Name) else (
+                        f.attr if isinstance(f, ast.Attribute) else None)
+                    if name in _FAULT_RECORD_FUNCS:
+                        return True
+        return False
 
     def visit_If(self, node):
         self._check_tracer_test(node.test, node.lineno, "if")

@@ -1,0 +1,483 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Fault registry + recovery-policy layer: every failure seam named,
+bounded, and proven recoverable (DESIGN.md "Fault-tolerance contract").
+
+The engine grew a large IMPLICIT failure surface — a prefetch worker
+re-raising at the driver's next fetch, a Mosaic refusal degrading to the
+XLA arm, an accumulator overflow rerunning eagerly, a chunk-store
+checksum refusing an entry — none of it enumerated, injected, or proven
+to recover. This module makes that surface a CHECKED contract, the same
+discipline exec/mem/conc audit apply to syncs, memory and locks:
+
+* **Registry** — :data:`SEAMS` names every failure seam with its
+  classification (``transient`` / ``degradable`` / ``fatal``) and its
+  recovery policy. A seam that is not registered cannot be injected; a
+  registered seam without a tier-1 injection fails
+  ``tests/test_faults.py``'s coverage check.
+* **Deterministic injection** — ``NDS_TPU_FAULT=seam:kind:nth`` (read at
+  USE time, never frozen at import — the PR 6/13 env-knob discipline)
+  makes the ``nth`` occurrence of :func:`fault_point` at ``seam`` raise
+  :class:`FaultInjected` (``kind=error``) or sleep
+  ``NDS_TPU_FAULT_HANG_S`` seconds first (``kind=hang`` — the hung-sync /
+  stuck-peer simulation the watchdog must beat). Exactly ONE injection
+  fires per process per spec: occurrence counting is process-global
+  under a lock, deterministic under threads.
+* **Recovery policies** — ``transient`` seams recover through
+  :func:`with_retry` (bounded attempts, deterministic backoff — no
+  randomness, so the diff harness's wall bound holds); ``degradable``
+  seams ride the existing degradation ladder (Pallas→XLA,
+  sharded→single-device, compiled→eager, partitioned rerun), now
+  evidence-recorded; ``fatal`` seams raise a classified
+  :class:`FaultError` promptly instead of hanging or corrupting.
+* **FaultEvent evidence** — every recovery records a
+  :class:`FaultEvent` into a thread-scoped bounded ring (mirroring
+  ``listener.StreamEvent``), drained per query by the drivers into
+  ``faultEvents`` next to ``streamedScans`` and into the campaign
+  ledger — so a fallback that fired in production is benchmark
+  evidence, not log noise (the reference suite's TaskFailureListener
+  idea, applied to the engine's own recovery paths). The
+  ``swallowed-fault`` jax_lint rule (error) statically requires any
+  except-clause catching a :class:`FaultError` to record an event or
+  re-raise.
+* **Statement watchdog** — ``NDS_TPU_STATEMENT_DEADLINE_S`` arms an
+  in-process per-statement deadline: :func:`bounded_call` runs a
+  blocking device->host fetch (or a peer wait) on a daemon helper
+  thread and raises :class:`StatementTimeout` — a classified error the
+  drivers map to status ``timeout`` — when the statement's remaining
+  budget runs out, instead of hanging the process. Unset (the default)
+  the call runs inline: zero threads, zero overhead, bit-for-bit
+  today's path.
+
+The runtime half is ``tools/fault_diff.py`` (tier-1 via
+``tests/test_faults.py``): it sweeps the injection matrix over the A/B
+subset and proves every seam either recovers bit-for-bit against the
+fault-free run or raises its classified error within the deadline —
+never hangs, never silently wrong rows — with FaultEvent counts matching
+injections exactly, and ``--inject-drift`` (suppress the recovery
+machinery via ``NDS_TPU_FAULT_DRIFT``) MUST fail.
+
+Deliberately STDLIB-ONLY (no jax, no nds_tpu imports): the bench.py
+parent and ``obs/ledger.py`` — both barred from the jax-importing
+package root — load this file by path (``nds_tpu.obs.ledger._faults_mod``)
+for the driver-side seams (``ledger-write``, ``bench-child``).
+
+Concurrency contract (analysis/conc_audit.py entry point): the
+occurrence counters are ONE dict under ONE dedicated lock
+(``_FAULT_LOCK``); the event ring is thread-local ``deque(maxlen)``;
+the statement scope is thread-local. Nothing else is shared.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+TRANSIENT = "transient"
+DEGRADABLE = "degradable"
+FATAL = "fatal"
+
+
+@dataclass(frozen=True)
+class Seam:
+    """One registered failure seam: where it lives, how it is classified,
+    and the recovery policy the diff harness proves. ``retries`` is the
+    bounded attempt allowance of a transient seam's :func:`with_retry`
+    (total attempts = retries + 1); ``retry_on`` names the exception
+    types the retry treats as transient — anything else propagates
+    unchanged, so a genuine engine bug is never masked by a retry loop."""
+
+    name: str
+    where: str
+    classify: str
+    recovery: str
+    retries: int = 0
+    retry_on: tuple = ()
+
+
+# THE registry: every fault_point() call names one of these. Order is
+# documentation order (DESIGN.md's seam table mirrors it; tests assert
+# the mirror).
+SEAMS = {s.name: s for s in (
+    Seam("prefetch", "engine/prefetch.py worker (slice+encode+upload)",
+         TRANSIENT,
+         "bounded retry of the prepare step on the worker; exhausted or "
+         "non-transient errors re-raise at the driver's next fetch "
+         "exactly like the inline path",
+         retries=2, retry_on=("FaultInjected", "OSError")),
+    Seam("device-put", "engine/stream.py _prepare_chunk[_sharded] "
+         "(host->device upload)",
+         TRANSIENT,
+         "covered by the prefetch seam's bounded retry (prepare wraps "
+         "the upload); inline/depth-0 paths retry on the driver",
+         retries=2, retry_on=("FaultInjected", "OSError")),
+    Seam("pipeline-compile", "engine/stream.py _build_pipeline / "
+         "StreamPipeline.compile",
+         DEGRADABLE,
+         "degrade compiled->eager: the statement reruns through the "
+         "eager chunk loop, bit-for-bit (the existing ladder, now "
+         "evidence-recorded)"),
+    Seam("exchange", "engine/stream.py _run_sharded collective dispatch "
+         "(parallel/exchange.py all-to-alls)",
+         DEGRADABLE,
+         "degrade sharded->single-device eager rerun, bit-for-bit"),
+    Seam("chunk-store-read", "io/chunk_store.py load_plan (mmap + CRC)",
+         TRANSIENT,
+         "corrupt entry (checksum/torn write): delete + re-encode from "
+         "the source arrow once; version drift stays a loud fatal "
+         "refusal (operator action)",
+         retries=1, retry_on=("FaultInjected",)),
+    Seam("chunk-store-write", "io/chunk_store.py save_plan (lock-file + "
+         "atomic rename)",
+         DEGRADABLE,
+         "best-effort persist: a failed/contended/killed write degrades "
+         "to the in-memory wire plan; a killed writer leaves old-valid "
+         "or none (lock-file steal by pid liveness)"),
+    Seam("sync", "engine/ops.py timed_read/host_sync (materializing "
+         "device->host fetch)",
+         TRANSIENT,
+         "bounded retry of the idempotent fetch; under "
+         "NDS_TPU_STATEMENT_DEADLINE_S a hung fetch raises "
+         "StatementTimeout (status 'timeout') instead of hanging",
+         retries=1, retry_on=("FaultInjected", "OSError")),
+    Seam("ledger-write", "obs/ledger.py Ledger.write (flush+fsync)",
+         TRANSIENT,
+         "one bounded retry, then degrade: the write is skipped with a "
+         "stderr note and a write_failures count — evidence loss is "
+         "recorded, the campaign continues",
+         retries=1, retry_on=("FaultInjected", "OSError")),
+    Seam("bench-child", "bench.py ChildServer.start (persistent serving "
+         "child)",
+         TRANSIENT,
+         "restart with deterministic-jittered backoff; 2 consecutive "
+         "setup failures trip the circuit breaker into a labeled "
+         "partial artifact (fail fast, never a burned round)"),
+    Seam("peer", "parallel/multihost.py maybe_initialize (federation "
+         "coordinator/peer attach)",
+         FATAL,
+         "classified FaultError raised promptly (no silent retry loop: "
+         "a half-formed federation must never run a collective); under "
+         "a deadline a stuck attach raises StatementTimeout"),
+)}
+
+
+class FaultError(RuntimeError):
+    """Base classified error of the fault layer: carries the seam name so
+    drivers and the diff harness can attribute it without string
+    matching. Every path out of a failed recovery raises one of these
+    (or re-raises the original, non-transient exception unchanged)."""
+
+    def __init__(self, seam: str, message: str):
+        super().__init__(message)
+        self.seam = seam
+
+
+class FaultInjected(FaultError):
+    """The deterministic injected fault (``NDS_TPU_FAULT``). Recovery
+    paths treat it exactly like the real fault it simulates; the diff
+    harness asserts they do."""
+
+
+class StatementTimeout(FaultError):
+    """The statement's ``NDS_TPU_STATEMENT_DEADLINE_S`` budget ran out
+    inside a blocking wait: the watchdog's classified error. Drivers map
+    it to status ``timeout``; the helper thread stays blocked (daemon)
+    but the process — and the campaign — moves on."""
+
+
+@dataclass
+class FaultEvent:
+    """One recovery (or classified failure) at a registered seam — the
+    evidence record the drivers drain per query next to StreamEvents.
+    ``action``: ``recovered`` (transient retry succeeded) | ``degrade``
+    (ladder step taken) | ``timeout`` (watchdog fired) | ``fatal``
+    (classified error raised) | ``note`` (diagnostic, e.g. heartbeat
+    survival)."""
+
+    seam: str
+    action: str
+    attempt: int = 0
+    detail: str = ""
+
+
+def fault_event_json(e: FaultEvent) -> dict:
+    """The ONE JSON shape of a FaultEvent in driver summaries
+    (``faultEvents`` next to ``streamedScans``) and the campaign
+    ledger."""
+    out = {"seam": e.seam, "action": e.action}
+    if e.attempt:
+        out["attempt"] = e.attempt
+    if e.detail:
+        out["detail"] = str(e.detail)[:200]
+    return out
+
+
+_fault_tls = threading.local()
+
+
+def record_fault_event(seam: str, action: str, attempt: int = 0,
+                       detail: str = "") -> None:
+    """Record one recovery event, thread-scoped like the sync counters
+    and StreamEvents (concurrent Throughput streams account their own
+    recoveries). Suppressed under ``NDS_TPU_FAULT_DRIFT`` — the
+    harness-only knob ``tools/fault_diff.py --inject-drift`` uses to
+    prove its event-count check can fail."""
+    if _drift():
+        return
+    lst = getattr(_fault_tls, "events", None)
+    if lst is None:
+        # deque(maxlen): diagnostics ring, never unbounded, O(1) evict
+        lst = _fault_tls.events = deque(maxlen=1000)
+    lst.append(FaultEvent(seam, action, attempt, detail))
+
+
+def drain_fault_events() -> list:
+    """Return and clear the calling thread's fault events (oldest first;
+    the ring keeps the newest 1000) — the per-query drain the drivers
+    run, mirroring ``listener.drain_stream_events``."""
+    lst = getattr(_fault_tls, "events", None)
+    if not lst:
+        return []
+    out = list(lst)
+    lst.clear()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# deterministic injection
+# ---------------------------------------------------------------------------
+
+# process-global occurrence counters: seam -> times fault_point() was
+# reached while an injection spec targeted it. ONE dict, ONE dedicated
+# lock (the conc-audit classification), reset by the diff harness
+# between matrix entries.
+_FAULT_COUNTS: dict = {}
+_FAULT_LOCK = threading.Lock()
+
+
+def _drift() -> bool:
+    """``NDS_TPU_FAULT_DRIFT``: harness-only recovery suppression —
+    with_retry stops retrying and event recording stops, so every
+    fault_diff recovery check MUST fail (the --inject-drift self-test).
+    Never set outside the harness."""
+    return bool(os.environ.get("NDS_TPU_FAULT_DRIFT"))
+
+
+def fault_spec():
+    """Parse ``NDS_TPU_FAULT=seam:kind:nth`` (read at USE time). Returns
+    ``(seam, kind, nth)`` or None. Unknown seams raise: a typo'd
+    injection silently never firing would make the diff harness pass
+    vacuously."""
+    env = os.environ.get("NDS_TPU_FAULT", "").strip()
+    if not env:
+        return None
+    parts = env.split(":")
+    seam = parts[0]
+    kind = parts[1] if len(parts) > 1 and parts[1] else "error"
+    try:
+        nth = int(parts[2]) if len(parts) > 2 else 1
+    except ValueError:
+        nth = 1
+    if seam not in SEAMS:
+        raise ValueError(f"NDS_TPU_FAULT names unregistered seam "
+                         f"{seam!r} (known: {sorted(SEAMS)})")
+    if kind not in ("error", "hang"):
+        raise ValueError(f"NDS_TPU_FAULT kind {kind!r} not in "
+                         "('error', 'hang')")
+    return seam, kind, max(nth, 1)
+
+
+def hang_seconds() -> float:
+    """``NDS_TPU_FAULT_HANG_S`` (default 30): how long a ``hang``-kind
+    injection blocks before raising — long enough that an un-watchdogged
+    statement visibly hangs, bounded so nothing wedges forever."""
+    try:
+        return float(os.environ.get("NDS_TPU_FAULT_HANG_S", "30"))
+    except ValueError:
+        return 30.0
+
+
+def reset_fault_counts() -> None:
+    """Zero the occurrence counters (diff-harness helper: each matrix
+    entry starts from a known state so ``nth`` is deterministic)."""
+    with _FAULT_LOCK:
+        _FAULT_COUNTS.clear()
+
+
+def fired_count(seam: str) -> int:
+    """How many fault_point() occurrences the seam has seen since the
+    last reset while targeted — the harness's injection-actually-fired
+    check."""
+    with _FAULT_LOCK:
+        return _FAULT_COUNTS.get(seam, 0)
+
+
+def fault_point(seam: str, detail: str = "") -> None:
+    """The injection seam: a no-op unless ``NDS_TPU_FAULT`` targets
+    ``seam``, in which case the ``nth`` occurrence raises
+    :class:`FaultInjected` (``kind=hang`` sleeps ``NDS_TPU_FAULT_HANG_S``
+    first — the hung-sync simulation). Exactly one injection fires per
+    spec per process; occurrences are counted under the lock so
+    concurrent threads agree on ``nth``. Callers place this at the TOP
+    of the seam's real work, so the simulated fault interrupts exactly
+    where a real one would."""
+    spec = fault_spec()
+    if spec is None or spec[0] != seam:
+        return
+    _seam, kind, nth = spec
+    with _FAULT_LOCK:
+        n = _FAULT_COUNTS[seam] = _FAULT_COUNTS.get(seam, 0) + 1
+    if n != nth:
+        return
+    if kind == "hang":
+        time.sleep(hang_seconds())
+    raise FaultInjected(seam, f"injected fault at seam {seam!r}"
+                        + (f" ({detail})" if detail else ""))
+
+
+# ---------------------------------------------------------------------------
+# recovery: bounded deterministic retry
+# ---------------------------------------------------------------------------
+
+# deterministic backoff schedule base (seconds): attempt k sleeps
+# base * 2^k — no randomness, so the diff harness's wall bound holds
+_BACKOFF_BASE_S = 0.02
+
+
+def _is_transient(exc: BaseException, seam: Seam) -> bool:
+    names = {t.__name__ for t in type(exc).__mro__}
+    return bool(names & set(seam.retry_on))
+
+
+def with_retry(seam_name: str, fn, record=record_fault_event):
+    """Run ``fn`` under the seam's bounded-retry policy: an exception in
+    the seam's ``retry_on`` set retries up to ``retries`` times with
+    deterministic backoff; success after k>0 failures records ONE
+    ``recovered`` FaultEvent (via ``record`` — ring workers pass a
+    sink that re-records on the driver thread); exhaustion re-raises
+    the last transient error unchanged (already classified when it is a
+    FaultError). Non-transient exceptions propagate untouched on the
+    FIRST attempt — a retry loop must never mask an engine bug.
+    ``NDS_TPU_FAULT_DRIFT`` suppresses the retries entirely (the
+    --inject-drift self-test)."""
+    seam = SEAMS[seam_name]
+    attempts = 1 + (0 if _drift() else max(seam.retries, 0))
+    last = None
+    for k in range(attempts):
+        try:
+            out = fn()
+        except BaseException as exc:
+            if not _is_transient(exc, seam) or k + 1 >= attempts:
+                raise
+            last = exc
+            time.sleep(_BACKOFF_BASE_S * (1 << k))
+            continue
+        if k > 0:
+            ev_seam = last.seam if isinstance(last, FaultError) \
+                else seam_name
+            record(ev_seam, "recovered", attempt=k,
+                   detail=f"{type(last).__name__}: {last}")
+        return out
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# statement watchdog
+# ---------------------------------------------------------------------------
+
+
+def statement_deadline_s() -> float | None:
+    """``NDS_TPU_STATEMENT_DEADLINE_S`` (read at use; unset/<=0 = off):
+    the per-statement wall budget the watchdog enforces at every
+    bounded wait."""
+    env = os.environ.get("NDS_TPU_STATEMENT_DEADLINE_S", "").strip()
+    if not env:
+        return None
+    try:
+        v = float(env)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+_stmt_tls = threading.local()
+
+
+class statement_scope:
+    """Thread-scoped statement clock (entered by ``Session.sql``): the
+    watchdog charges every bounded wait against ONE per-statement
+    budget, so N slow fetches cannot each consume a fresh deadline.
+    Re-entrant statements (a view definition executing a query) keep the
+    OUTER clock — the statement the user is waiting on."""
+
+    def __enter__(self):
+        self._outer = getattr(_stmt_tls, "start", None)
+        if self._outer is None:
+            _stmt_tls.start = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        if self._outer is None:
+            _stmt_tls.start = None
+        return False
+
+
+def _remaining_s() -> float | None:
+    """Remaining statement budget, or None when the watchdog is off.
+    Outside any statement scope the full deadline applies per wait."""
+    deadline = statement_deadline_s()
+    if deadline is None:
+        return None
+    start = getattr(_stmt_tls, "start", None)
+    if start is None:
+        return deadline
+    return deadline - (time.monotonic() - start)
+
+
+def bounded_call(seam_name: str, fn):
+    """Run a blocking wait under the statement watchdog. Watchdog off
+    (the default): call inline — zero threads, zero overhead,
+    bit-for-bit today's path. Armed: the call runs on a daemon helper
+    thread and the driver waits at most the statement's REMAINING
+    budget; expiry records a ``timeout`` FaultEvent and raises
+    :class:`StatementTimeout` (the helper stays blocked — an
+    interruptible hang does not exist in-process; the classified error
+    is the contract). A helper-thread exception re-raises on the
+    driver unchanged."""
+    remaining = _remaining_s()
+    if remaining is None:
+        return fn()
+    if remaining <= 0:
+        record_fault_event(seam_name, "timeout",
+                           detail="statement budget exhausted")
+        raise StatementTimeout(
+            seam_name, f"statement deadline "
+            f"({statement_deadline_s()}s) already exhausted before the "
+            f"{seam_name!r} wait")
+    box: list = []
+    done = threading.Event()
+
+    def runner():
+        try:
+            box.append(("ok", fn()))
+        except BaseException as exc:  # propagate to the driver, always
+            box.append(("err", exc))
+        finally:
+            done.set()
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name=f"nds-watchdog-{seam_name}")
+    t.start()
+    if not done.wait(timeout=remaining):
+        record_fault_event(seam_name, "timeout",
+                           detail=f"blocked > {remaining:.1f}s remaining")
+        raise StatementTimeout(
+            seam_name, f"{seam_name!r} wait exceeded the statement "
+            f"deadline (NDS_TPU_STATEMENT_DEADLINE_S="
+            f"{statement_deadline_s()}); statement marked timeout")
+    kind, val = box[0]
+    if kind == "err":
+        raise val
+    return val

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nds_tpu.engine import faults as _faults
 from nds_tpu.engine.column import Column, encs_equal, is_dec
 from nds_tpu.engine.table import DeviceTable
 from nds_tpu.obs import trace as _trace
@@ -435,16 +436,35 @@ def host_read(tag: str, fetch):
     return val
 
 
+def _guarded_blocking_fetch(tag: str, fetch):
+    """The ``sync`` fault seam around one blocking device->host fetch:
+    bounded deterministic retry of the idempotent read (transient
+    tunnel/device flakes and injected faults recover in place — the
+    retry RE-CHARGES the same sync accounting, never re-budgets it:
+    exec_audit's retry-paths row), and the statement watchdog
+    (``NDS_TPU_STATEMENT_DEADLINE_S``): a hung fetch raises a classified
+    :class:`faults.StatementTimeout` instead of hanging the process.
+    Watchdog unset (the default): the fetch runs inline — zero threads,
+    bit-for-bit today's path."""
+    return _faults.with_retry(
+        "sync",
+        lambda: _faults.bounded_call(
+            "sync",
+            lambda: (_faults.fault_point("sync", tag), fetch())[1]))
+
+
 def timed_read(tag: str, fetch):
     """host_read() with the fetch charged to the thread's sync/wait
     accounting — for blocking device->host reads that are not simple
     scalar syncs (chunk spans, exchange overflow counters, whole-column
-    string/date fetches), so PERF.md's roofline sees them too."""
+    string/date fetches), so PERF.md's roofline sees them too. The raw
+    fetch runs behind the ``sync`` fault seam (retry + watchdog); the
+    sync counters stay charged on the CALLING thread either way."""
 
     def timed():
         add_syncs()
         t0 = time.perf_counter_ns()
-        out = fetch()
+        out = _guarded_blocking_fetch(tag, fetch)
         add_sync_wait(time.perf_counter_ns() - t0)
         return out
 
@@ -460,7 +480,7 @@ def host_sync(value) -> int:
     def fetch():
         add_syncs()
         t0 = time.perf_counter_ns()
-        out = int(value)
+        out = _guarded_blocking_fetch("sync", lambda: int(value))
         add_sync_wait(time.perf_counter_ns() - t0)
         return out
 
@@ -551,8 +571,11 @@ def resolve_counts() -> None:
     def fetch():
         t0 = time.perf_counter_ns()
         # on a failed transfer (device preemption) the list survives
-        # untouched, so a retry drains it instead of stranding counts
-        vals = jax.device_get([c.dev for c in pend])
+        # untouched, so a retry drains it instead of stranding counts —
+        # the ``sync`` fault seam (bounded retry + statement watchdog)
+        # wraps the raw transfer, accounting stays on this thread
+        vals = _guarded_blocking_fetch(
+            "counts", lambda: jax.device_get([c.dev for c in pend]))
         add_sync_wait(time.perf_counter_ns() - t0)
         add_syncs()
         return [int(v) for v in vals]

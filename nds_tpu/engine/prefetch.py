@@ -65,10 +65,21 @@ import queue
 import threading
 import time
 
+from nds_tpu.engine import faults as _F
+
 # sentinel kinds riding the queue (payloads are (kind, value) pairs)
 _ITEM = "item"
 _DONE = "done"
 _ERR = "err"
+
+
+def _prepare_guarded(prepare, item):
+    """One prepare attempt behind the ``prefetch`` fault seam: the
+    injection point sits exactly where a real slice/encode/upload fault
+    would interrupt (``device-put`` injections fire inside ``prepare``
+    itself — engine/stream.py's ``_prepare_chunk``)."""
+    _F.fault_point("prefetch")
+    return item if prepare is None else prepare(item)
 
 # how long a blocked worker put waits between shutdown checks: short
 # enough that close() never stalls the caller, long enough to stay off
@@ -106,7 +117,11 @@ class _InlineRing:
             item = next(self._it, None)
             if item is None:
                 return None
-            return item if self._prepare is None else self._prepare(item)
+            # same bounded-retry policy as the threaded worker (the
+            # ``prefetch`` transient seam), on the driver thread — the
+            # depth-0 pump stays bit-for-bit except under a real fault
+            return _F.with_retry(
+                "prefetch", lambda: _prepare_guarded(self._prepare, item))
         finally:
             self.stall_ns += time.perf_counter_ns() - t0
 
@@ -133,6 +148,13 @@ class ChunkRing:
         self._stop = threading.Event()
         self._exhausted = False
         self.stall_ns = 0
+        # worker-side recovery evidence: FaultEvents are thread-scoped
+        # (like sync counters), so a retry that recovered ON THE WORKER
+        # parks its event here and the driver re-records it into its own
+        # ring at the next fetch — instance state under one dedicated
+        # lock (the conc-audit classification)
+        self._faults: list = []
+        self._faults_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._work, args=(iter(it), prepare), daemon=True,
             name=name)
@@ -151,12 +173,34 @@ class ChunkRing:
                 continue
         return False
 
+    def _sink(self, seam, action, attempt=0, detail=""):
+        """Worker-side FaultEvent sink (see __init__)."""
+        with self._faults_lock:
+            self._faults.append((seam, action, attempt, detail))
+
+    def _drain_worker_faults(self) -> None:
+        """Re-record worker-side recovery events on the DRIVER thread so
+        they land in the query's own evidence ring."""
+        with self._faults_lock:
+            got, self._faults[:] = list(self._faults), []
+        for (seam, action, attempt, detail) in got:
+            _F.record_fault_event(seam, action, attempt=attempt,
+                                  detail=detail)
+
     def _work(self, it, prepare) -> None:
         try:
             for item in it:
                 if self._stop.is_set():
                     return
-                payload = item if prepare is None else prepare(item)
+                # bounded deterministic retry of the prepare step (the
+                # ``prefetch`` transient seam): a transient slice/encode/
+                # upload fault recovers in place; exhausted or
+                # non-transient errors ride the queue and re-raise at the
+                # driver's next fetch exactly like the inline path
+                payload = _F.with_retry(
+                    "prefetch",
+                    lambda i=item: _prepare_guarded(prepare, i),
+                    record=self._sink)
                 if not self._put((_ITEM, payload)):
                     return
             self._put((_DONE, None))
@@ -174,6 +218,7 @@ class ChunkRing:
         t0 = time.perf_counter_ns()
         kind, value = self._q.get()
         self.stall_ns += time.perf_counter_ns() - t0
+        self._drain_worker_faults()
         if kind is _ITEM:
             return value
         self._exhausted = True
@@ -189,7 +234,9 @@ class ChunkRing:
 
     def close(self) -> None:
         """Clean shutdown (idempotent): signal the worker, drain the
-        queue so a backpressure-blocked put wakes, join the thread."""
+        queue so a backpressure-blocked put wakes, join the thread. Any
+        worker-side recovery evidence still parked is re-recorded here
+        so a fault on the FINAL chunk is never lost."""
         self._stop.set()
         self._exhausted = True
         while True:
@@ -198,6 +245,7 @@ class ChunkRing:
             except queue.Empty:
                 break
         self._thread.join(timeout=60.0)
+        self._drain_worker_faults()
 
     def __enter__(self):
         return self

@@ -152,9 +152,15 @@ def _split_jaxpr(closed, max_eqns):
         seg_consts = [const_of[v] for v in invars if v in const_of]
         cvars = [v for v in invars if v in const_of]
         rvars = [v for v in invars if v not in const_of]
+        # NO debug_info on segments: a segment's invars/outvars are a
+        # re-partition of the whole program's, so inheriting its
+        # arg_names/result_paths trips the constructor's length
+        # assertion on jax >= 0.4.30 and the whole split silently
+        # blacklisted the query (the seed's one red tier-1 test). The
+        # segments are synthetic — there are no user-meaningful names
+        # to preserve.
         seg = jex_core.Jaxpr(constvars=cvars, invars=rvars,
-                             outvars=outvars, eqns=eqns,
-                             debug_info=jaxpr.debug_info)
+                             outvars=outvars, eqns=eqns)
         segments.append((seg, seg_consts, rvars, outvars))
     return segments, list(jaxpr.outvars), const_of
 

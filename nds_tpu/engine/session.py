@@ -425,8 +425,16 @@ class Session:
         # scalar-subquery checks; without the barrier those leak and raise
         # inside a later statement's first resolution (misattributed), and
         # a failed statement's half-registered checks mask its real error
+        # per-statement watchdog scope (engine/faults.py): with
+        # NDS_TPU_STATEMENT_DEADLINE_S armed, every blocking wait below
+        # charges ONE shared statement budget — a hung sync or stuck
+        # peer raises a classified StatementTimeout (drivers mark the
+        # statement `timeout`) instead of hanging the process. Unset:
+        # zero overhead.
+        from nds_tpu.engine import faults as _F
         try:
-            out = self._sql_dispatch(text, stmt, planner)
+            with _F.statement_scope():
+                out = self._sql_dispatch(text, stmt, planner)
         except BaseException:
             E.discard_deferred_checks()
             raise

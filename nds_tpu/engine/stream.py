@@ -153,6 +153,7 @@ import weakref
 import jax
 import jax.numpy as jnp
 
+from nds_tpu.engine import faults as _F
 from nds_tpu.engine import kernels as _K
 from nds_tpu.engine import ops as E
 from nds_tpu.engine import prefetch as _PF
@@ -928,6 +929,9 @@ class StreamPipeline:
         NO spans — the ``host-sync-in-prefetch-worker`` contract (padded
         chunks carry a plain-int live count, so no DeviceCount resolve
         is ever needed here)."""
+        _F.fault_point("device-put")       # upload seam (transient;
+        #                                    recovered by the prefetch
+        #                                    ring's bounded retry)
         flat = self._flatten_chunk(chunk)
         n_dev = jnp.asarray(int(chunk.nrows), dtype=jnp.int64)
         h2d = sum(int(x.nbytes) for x in flat if x is not None)
@@ -940,6 +944,7 @@ class StreamPipeline:
         across the mesh off the driver thread instead of funneling
         through one inline upload."""
         from jax.sharding import NamedSharding, PartitionSpec as PSpec
+        _F.fault_point("device-put")
         row = NamedSharding(self.mesh, PSpec(self.mesh_axis))
         flat = self._flatten_chunk(chunk)
         n_dev = jnp.asarray(int(chunk.nrows), dtype=jnp.int64)
@@ -1048,7 +1053,11 @@ class StreamPipeline:
         n_chunks = 0
         h2d = 0
         try:
-            cur = self._prepare_chunk(first_chunk)
+            # the first chunk prepares INLINE (the record phase already
+            # converted it): same bounded-retry policy as the ring's
+            # worker, on the driver (the device-put transient seam)
+            cur = _F.with_retry(
+                "device-put", lambda: self._prepare_chunk(first_chunk))
             while cur is not None:
                 flat, n_dev, nb = cur
                 # actual host->device prefetch bytes (buffer metadata,
@@ -1147,7 +1156,8 @@ class StreamPipeline:
         n_chunks = 0
         h2d = 0
         try:
-            cur = self._prepare_chunk(first_chunk)
+            cur = _F.with_retry(
+                "device-put", lambda: self._prepare_chunk(first_chunk))
             while cur is not None:
                 flat, n_dev, nb = cur
                 h2d += nb
@@ -1275,12 +1285,18 @@ def _run_sharded(pipe, chunks, first_chunk, parts_flat, resid_flat=()):
     n_chunks = 0
     h2d = 0
     try:
-        cur = pipe._prepare_chunk_sharded(first_chunk)
+        cur = _F.with_retry(
+            "device-put", lambda: pipe._prepare_chunk_sharded(first_chunk))
         while cur is not None:
             flat, n_dev, nb = cur
             h2d += nb
             pids = live = None
             if pipe.exchange:
+                # collective-dispatch seam (degradable): an injected
+                # exchange fault propagates to stream_execute, which
+                # degrades the statement to the single-device eager
+                # rerun and records the FaultEvent
+                _F.fault_point("exchange")
                 with _obs.span("stream.exchange", chunk=n_chunks,
                                shards=S, partitions=P):
                     flat, live, pids, hist, ex_ovf = first_traced(
@@ -1667,12 +1683,26 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
     # label the planner's enclosing "stream" span with the cache outcome
     _obs.annotate(pipelineCache="hit" if pipe is not None else "miss")
 
+    degrade_reason = None
     if pipe is None:
         try:
-            pipe, resid_infos = _build_pipeline(
-                planner, parts, keep, alias, join_preds, where_conjuncts,
-                masked_sources, part_infos, outer_meta, first, chunk_spec,
-                chunk_cap, n_chunks)
+            try:
+                pipe, resid_infos = _build_pipeline(
+                    planner, parts, keep, alias, join_preds,
+                    where_conjuncts, masked_sources, part_infos,
+                    outer_meta, first, chunk_spec, chunk_cap, n_chunks)
+            except _F.FaultInjected as exc:
+                # pipeline-compile seam (degradable): the designed
+                # recovery is the compiled->eager ladder step — record
+                # the evidence and fall back, even under strict (this
+                # IS the policy the fault matrix proves, not a bug
+                # hiding in a fallback)
+                _F.record_fault_event(exc.seam, "degrade",
+                                      detail="compiled->eager: "
+                                      f"{exc}")
+                pipe, resid_infos = None, ()
+                degrade_reason = (f"fault: {exc.seam} "
+                                  "(degraded compiled->eager)")
             if pipe is not None and key is not None:
                 with _PIPELINE_LOCK:
                     _PIPELINE_BUILD_COUNTS[key] = \
@@ -1691,7 +1721,7 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
                     _PIPELINE_BUILDS.pop(key, None)
                 claim.set()
         if pipe is None:
-            return None, "not chunk-invariant"
+            return None, degrade_reason or "not chunk-invariant"
 
     resid_flat = tuple(tuple(flat) for (_spec, flat) in resid_infos)
     snapshot = list(E._pending_counts())
@@ -1705,6 +1735,28 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
         # to the trace, not this execution — drop them before any
         # downstream resolve_counts() would device_get them
         _restore_counts(snapshot, checks_snapshot)
+    except _F.StatementTimeout:
+        # the statement watchdog fired inside a drive-time wait: the
+        # statement is MARKED timeout (drivers map the classified error
+        # to status "timeout") — degrading to an eager rerun would pay
+        # the hang again. The event was recorded at the wait.
+        _restore_counts(snapshot, checks_snapshot)
+        raise
+    except _F.FaultError as exc:
+        # a drive-time fault at a degradable seam (exchange dispatch, an
+        # exhausted transient retry): the designed recovery is the
+        # degradation ladder — sharded/compiled -> single-device eager
+        # rerun, bit-for-bit. Recorded as evidence; deliberate even
+        # under strict (the fault matrix proves this path).
+        _restore_counts(snapshot, checks_snapshot)
+        with _PIPELINE_LOCK:
+            _PIPELINE_CACHE.pop(key, None)
+            _PIPELINE_BUILD_COUNTS.pop(key, None)
+        _F.record_fault_event(exc.seam, "degrade",
+                              detail=f"drive fault -> eager rerun: {exc}")
+        log.info("streamed pipeline hit fault seam %s; re-running %s "
+                 "eagerly", exc.seam, alias)
+        return None, f"fault: {exc.seam} (degraded to eager)"
     except (E.ReplayMismatch, E.StreamSyncError, ValueError, TypeError,
             NotImplementedError, jax.errors.TracerArrayConversionError,
             jax.errors.ConcretizationTypeError,
@@ -1792,6 +1844,10 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
     residual operands."""
     from nds_tpu.engine.replay import _lift_log
     from nds_tpu.sql.planner import _OuterBuild, _OuterProbe
+    # pipeline-compile seam (degradable): an injected build/compile
+    # fault degrades this statement to the eager chunk loop (the
+    # handler lives in stream_execute, which records the FaultEvent)
+    _F.fault_point("pipeline-compile")
     snapshot = list(E._pending_counts())
     checks_snapshot = [c for c, _f in
                        (getattr(E._sync_tls, "checks", None) or [])]

@@ -249,8 +249,22 @@ class ChunkedTable:
         hit = self._wire_store.get(key)
         if hit is not None:
             return hit
-        plan = chunk_store.load_plan(root, self.arrow,
-                                     self.canonical_types)
+        from nds_tpu.engine import faults as _F
+        try:
+            plan = chunk_store.load_plan(root, self.arrow,
+                                         self.canonical_types)
+        except (chunk_store.ChunkStoreCorrupt, _F.FaultInjected) as exc:
+            # chunk-store-read seam recovery (transient, bounded at one
+            # re-encode): the store is a CACHE of the source arrow data,
+            # so a corrupt entry (torn write, bit rot, injected fault)
+            # is deleted and rebuilt from source — evidence-recorded,
+            # never a failed statement, never corrupt codes uploaded.
+            # Version drift stays a loud ChunkStoreError (fatal).
+            _F.record_fault_event("chunk-store-read", "recovered",
+                                  attempt=1, detail=str(exc)[:200])
+            chunk_store.invalidate_entry(root, self.arrow,
+                                         self.canonical_types)
+            plan = None
         if plan is None:
             plan = self._build_wire_plan()
             # persisting is best-effort: a full disk, a read-only store
@@ -261,6 +275,10 @@ class ChunkedTable:
                 chunk_store.save_plan(root, self.arrow,
                                       self.canonical_types, plan)
             except Exception as exc:
+                # chunk-store-write seam degrade (evidence-recorded):
+                # the statement proceeds on the plan just built
+                _F.record_fault_event("chunk-store-write", "degrade",
+                                      detail=str(exc)[:200])
                 import logging
                 logging.getLogger(__name__).warning(
                     "chunk store save failed (%s); serving the "

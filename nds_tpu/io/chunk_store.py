@@ -21,15 +21,20 @@ while the data doesn't. This module persists the wire format ONCE:
   the prefetch ring and never touches arrow slicing or codec planning
   again — the files ARE the upload format.
 
-Integrity is refused loudly, staleness silently:
+Integrity is refused at load, recovered at the caller, staleness
+silent (the ``chunk-store-read``/``-write`` seams of DESIGN.md
+"Fault-tolerance contract"):
 
 * **version gate** — a manifest whose ``version`` is not this module's
-  :data:`STORE_VERSION` raises :class:`ChunkStoreError`: an old (or
-  newer) writer's layout must never be silently reinterpreted.
+  :data:`STORE_VERSION` raises :class:`ChunkStoreError`: FATAL — an old
+  (or newer) writer's layout must never be silently reinterpreted.
 * **checksum** — every buffer file carries a CRC32 in the manifest,
   verified at load before the mmap is handed out; a mismatch (torn
-  write, bit rot, concurrent overwrite) raises :class:`ChunkStoreError`
-  rather than uploading corrupt codes.
+  write, bit rot) raises :class:`ChunkStoreCorrupt` rather than
+  uploading corrupt codes. TRANSIENT: the engine caller
+  (``ChunkedTable._wire_plan``) deletes the entry, re-encodes from the
+  source arrow once, and records a FaultEvent — the statement survives,
+  wrong codes never upload.
 * **stale-codec-plan invalidation** — the manifest records a content
   fingerprint of the source table (row count, schema, buffer sizes and
   head/tail samples, the codec-relevant knobs); a table whose data
@@ -39,15 +44,23 @@ Integrity is refused loudly, staleness silently:
 
 The store is keyed by table IDENTITY (column names + canonical types +
 row count), so a re-generated table of the same shape reuses the same
-directory slot and invalidation-by-fingerprint does the rest. Writes go
-through a temp-dir rename so a killed writer leaves either the old
-entry or none — never a half entry (the torn half would fail its CRC
-anyway; the rename just keeps the common case clean).
+directory slot and invalidation-by-fingerprint does the rest.
 
-Env: ``NDS_TPU_CHUNK_STORE`` (directory; unset/empty = store off) and
+Concurrent-writer safety: writers serialize on a pid-stamped lock file
+per entry slot (:func:`_acquire_entry_lock`; a second LIVE writer
+yields — the first writer's entry is equally valid — while a dead
+pid's or over-age lock is stolen), buffers land in a temp dir, and ONE
+atomic ``os.replace`` swaps the entry in. Two processes warming one
+store directory can never interleave inside a slot, and a writer
+killed mid-write leaves either the old-valid entry or none — never a
+half entry — plus a stale lock the next writer reclaims (proven by the
+killed-writer subprocess test in ``tests/test_chunk_store.py``).
+
+Env: ``NDS_TPU_CHUNK_STORE`` (directory; unset/empty = store off),
 ``NDS_TPU_CHUNK_STORE_VERIFY`` (default on; ``0`` skips the full CRC
-read at load for very large trusted stores), both read at use time
-like every other knob.
+read at load for very large trusted stores) and
+``NDS_TPU_CHUNK_STORE_LOCK_STALE_S`` (writer-lock steal age, default
+600), all read at use time like every other knob.
 """
 
 from __future__ import annotations
@@ -55,6 +68,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import time
 import zlib
 from dataclasses import dataclass
 from hashlib import sha256
@@ -78,10 +92,20 @@ _MANIFEST = "manifest.json"
 
 
 class ChunkStoreError(RuntimeError):
-    """A store entry that must not be used: version drift or checksum
-    failure. Deliberately NOT silently absorbed — a corrupt wire file
-    uploading wrong codes would be a wrong-results bug, so the statement
-    fails loudly and the operator deletes/regenerates the entry."""
+    """A store entry that must not be used. Version drift stays in this
+    base class — FATAL by classification (an old layout must never be
+    silently reinterpreted; the operator deletes or upgrades)."""
+
+
+class ChunkStoreCorrupt(ChunkStoreError):
+    """A corrupt entry: checksum mismatch, torn write, missing buffer
+    file, unreadable manifest. TRANSIENT by classification
+    (``chunk-store-read`` seam): the store is a cache of the source
+    arrow data, so the caller (``engine/table.ChunkedTable._wire_plan``)
+    deletes the entry, re-encodes from source ONCE, and records a
+    FaultEvent — wrong codes are never uploaded, and a single flipped
+    bit no longer fails the statement. Loaded directly (tests, tools),
+    this still raises loudly."""
 
 
 def store_root() -> str | None:
@@ -182,16 +206,127 @@ def _entry_dir(root: str, arrow, canonical_types: dict) -> str:
     return os.path.join(root, _identity_digest(arrow, canonical_types))
 
 
+def invalidate_entry(root: str, arrow, canonical_types: dict) -> None:
+    """Delete one table's store entry (the corrupt-entry recovery of the
+    ``chunk-store-read`` seam): the next ``load_plan`` reads a MISS and
+    the caller re-encodes from source."""
+    import shutil
+    shutil.rmtree(_entry_dir(root, arrow, canonical_types),
+                  ignore_errors=True)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True      # e.g. EPERM: exists but not ours
+    return True
+
+
+def lock_stale_s() -> float:
+    """``NDS_TPU_CHUNK_STORE_LOCK_STALE_S`` (default 600, read at use):
+    age past which a writer lock is stolen even when its recorded pid
+    appears alive (pid reuse on a long-lived host)."""
+    try:
+        return float(os.environ.get("NDS_TPU_CHUNK_STORE_LOCK_STALE_S",
+                                    "600"))
+    except ValueError:
+        return 600.0
+
+
+def _acquire_entry_lock(final: str):
+    """The concurrent-writer lock of one entry slot: an ``O_EXCL`` lock
+    file beside the entry dir, pid recorded inside. Returns the lock
+    path, or None when another LIVE writer holds it (the caller then
+    skips persisting — the other writer's entry is equally valid). A
+    lock whose pid is dead (killed writer) or whose mtime is past the
+    staleness bound is STOLEN: a kill mid-write must never wedge the
+    slot forever."""
+    path = final + ".lock"
+    for _attempt in (0, 1):
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            try:
+                os.write(fd, str(os.getpid()).encode())
+            finally:
+                os.close(fd)
+            return path
+        except FileExistsError:
+            try:
+                with open(path) as f:
+                    pid = int(f.read().strip() or "0")
+            except FileNotFoundError:
+                continue                 # lock vanished: retry O_EXCL
+            except (OSError, ValueError):
+                pid = 0
+            try:
+                age = time.time() - os.path.getmtime(path)
+            except OSError:
+                continue                 # lock vanished: retry O_EXCL
+            # pid 0 = not yet stamped (a writer caught between its
+            # O_EXCL and its write): only the AGE bound may steal it —
+            # treating unstamped-as-dead would unlink a live writer's
+            # fresh lock and let two writers interleave in one slot
+            stale = age > lock_stale_s() or \
+                (pid > 0 and not _pid_alive(pid))
+            if not stale:
+                return None              # live writer: let it win
+            # steal ATOMICALLY via rename: of N concurrent stealers
+            # exactly one wins (the losers' rename raises ENOENT), so a
+            # freshly re-acquired lock can never be unlinked out from
+            # under its new holder; the winner retries the O_EXCL
+            grave = f"{path}.stale-{os.getpid()}"
+            try:
+                os.rename(path, grave)
+                os.unlink(grave)
+            except OSError:
+                pass                     # lost the steal race: retry
+    return None
+
+
+def _release_entry_lock(path: str) -> None:
+    """Unlink the lock ONLY while it still holds our pid: after an
+    age-based steal the slot's lock belongs to the STEALER, and blindly
+    unlinking it would invite a third writer in beside them."""
+    try:
+        with open(path) as f:
+            if f.read().strip() != str(os.getpid()):
+                return
+    except OSError:
+        return                           # gone or unreadable: not ours
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
 def save_plan(root: str, arrow, canonical_types: dict,
-              plan: dict) -> str:
+              plan: dict) -> str | None:
     """Persist one table's wire plan (``name -> WireColumn``) under
-    ``root``; returns the entry directory. Atomic-ish: buffers land in a
-    temp dir first, the final rename swaps the entry in whole."""
+    ``root``; returns the entry directory, or None when another live
+    writer holds the entry's lock (its entry is equally valid — the
+    caller serves its in-memory plan).
+
+    Concurrent-writer safety (the ``chunk-store-write`` seam): writers
+    serialize on a pid-stamped lock file per entry slot, buffers land in
+    a temp dir, and ONE atomic ``os.replace`` swaps the entry in — so
+    two processes warming one store directory can never interleave
+    inside a slot, and a writer killed mid-write leaves either the
+    old-valid entry or none (plus a stale lock the next writer steals by
+    pid liveness / age), never a half entry."""
+    import shutil
     final = _entry_dir(root, arrow, canonical_types)
     os.makedirs(root, exist_ok=True)
-    tmp = tempfile.mkdtemp(prefix=".chunkstore-", dir=root)
-    cols = []
+    lock = _acquire_entry_lock(final)
+    if lock is None:
+        return None
+    tmp = None
     try:
+        from nds_tpu.engine import faults as _F
+        tmp = tempfile.mkdtemp(prefix=".chunkstore-", dir=root)
+        cols = []
         for i, name in enumerate(arrow.column_names):
             wc = plan[name]
             rec = {"name": name, "codec": wc.codec, "kind": wc.kind,
@@ -200,6 +335,10 @@ def save_plan(root: str, arrow, canonical_types: dict,
             dp = os.path.join(tmp, f"{i:03d}.data.npy")
             np.save(dp, np.ascontiguousarray(wc.data))
             rec["crc"]["data"] = _crc_file(dp)
+            # chunk-store-write seam: a hang-kind injection parks the
+            # writer mid-entry — the killed-writer test SIGKILLs here
+            # and the old-valid-or-none guarantee must hold
+            _F.fault_point("chunk-store-write", detail=name)
             if wc.valid is not None:
                 vp = os.path.join(tmp, f"{i:03d}.valid.npy")
                 np.save(vp, np.ascontiguousarray(wc.valid))
@@ -223,26 +362,21 @@ def save_plan(root: str, arrow, canonical_types: dict,
                     "nrows": int(arrow.num_rows), "columns": cols}
         with open(os.path.join(tmp, _MANIFEST), "w") as f:
             json.dump(manifest, f, indent=1)
-        # swap the whole entry in (replace any stale predecessor). Two
-        # attempts: a concurrent writer may land its own entry between
-        # our rmtree and replace — on the second failure give up and
-        # let the caller serve its in-memory plan (the other writer's
-        # entry is equally valid)
-        import shutil
-        for attempt in (0, 1):
-            if os.path.isdir(final):
-                shutil.rmtree(final, ignore_errors=True)
-            try:
-                os.replace(tmp, final)
-                return final
-            except OSError:
-                if attempt:
-                    raise
+        # the one swap: under the lock no concurrent writer can land
+        # between the rmtree and the replace, so the slot is always
+        # old-valid, new-valid, or (for the instant between the two
+        # calls under a kill) absent — never interleaved
+        if os.path.isdir(final):
+            shutil.rmtree(final, ignore_errors=True)
+        os.replace(tmp, final)
+        tmp = None
         return final
     except BaseException:
-        import shutil
-        shutil.rmtree(tmp, ignore_errors=True)
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
         raise
+    finally:
+        _release_entry_lock(lock)
 
 
 def verify_enabled() -> bool:
@@ -258,13 +392,13 @@ def verify_enabled() -> bool:
 def _load_buffer(d: str, fname: str, want_crc: int, mmap: bool):
     path = os.path.join(d, fname)
     if not os.path.exists(path):
-        raise ChunkStoreError(
+        raise ChunkStoreCorrupt(
             f"chunk store entry {d} is missing {fname} (torn write?); "
             "delete the entry to re-encode")
     if verify_enabled():
         got = _crc_file(path)
         if got != want_crc:
-            raise ChunkStoreError(
+            raise ChunkStoreCorrupt(
                 f"chunk store checksum mismatch on {path}: manifest "
                 f"{want_crc:#010x} != file {got:#010x}; refusing to "
                 "upload corrupt wire data — delete the entry to "
@@ -279,7 +413,12 @@ def load_plan(root: str, arrow, canonical_types: dict,
     matches the source data — the stale-codec-plan invalidation).
     Raises :class:`ChunkStoreError` on version drift or checksum
     failure — never silently serves a suspect entry."""
+    from nds_tpu.engine import faults as _F
     from nds_tpu.engine.column import Encoding
+    # chunk-store-read seam (transient): an injected read fault takes
+    # the same recovery as a real corrupt entry — delete + re-encode at
+    # the caller, evidence-recorded
+    _F.fault_point("chunk-store-read")
     d = _entry_dir(root, arrow, canonical_types)
     mpath = os.path.join(d, _MANIFEST)
     if not os.path.exists(mpath):
@@ -288,7 +427,7 @@ def load_plan(root: str, arrow, canonical_types: dict,
         with open(mpath) as f:
             manifest = json.load(f)
     except (OSError, json.JSONDecodeError) as exc:
-        raise ChunkStoreError(
+        raise ChunkStoreCorrupt(
             f"chunk store manifest {mpath} unreadable: {exc}; delete "
             "the entry to re-encode") from exc
     if manifest.get("version") != STORE_VERSION:
@@ -315,8 +454,12 @@ def load_plan(root: str, arrow, canonical_types: dict,
         values, enc = None, None
         if rec["codec"] == "str":
             sp = os.path.join(d, f"{i:03d}.values.json")
+            if not os.path.exists(sp):
+                raise ChunkStoreCorrupt(
+                    f"chunk store entry {d} is missing {sp} (torn "
+                    "write?); delete the entry to re-encode")
             if verify_enabled() and _crc_file(sp) != rec["crc"]["values"]:
-                raise ChunkStoreError(
+                raise ChunkStoreCorrupt(
                     f"chunk store checksum mismatch on {sp}; refusing "
                     "to decode against a corrupt dictionary")
             with open(sp) as f:

@@ -58,6 +58,30 @@ import time
 
 LEDGER_VERSION = 1
 
+
+def _faults_mod():
+    """The fault registry (``nds_tpu/engine/faults.py``) WITHOUT pulling
+    the jax-importing package root: reuse the already-imported module
+    when the engine is loaded (power.py in-process), else load the file
+    by path (the bench.py parent, which must never touch jax — faults.py
+    is stdlib-only by contract). The ``ledger-write`` / ``bench-child``
+    seams route through this."""
+    m = sys.modules.get("nds_tpu.engine.faults")
+    if m is not None:
+        return m
+    m = sys.modules.get("_nds_tpu_faults_standalone")
+    if m is not None:
+        return m
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "engine", "faults.py")
+    spec = importlib.util.spec_from_file_location(
+        "_nds_tpu_faults_standalone", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_nds_tpu_faults_standalone"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
 # record kinds -> required fields (beyond v/kind/t)
 _REQUIRED = {
     "meta": ("driver",),
@@ -300,23 +324,48 @@ class Ledger:
         # survive
         self._lock = threading.RLock()
         self._closed = False
+        # ledger-write seam evidence: writes that degraded (skipped
+        # after the bounded retry) — the campaign continues, the loss
+        # is counted, finalize() can surface it
+        self.write_failures = 0
         if meta and not preexisting:
             self.write("meta", **meta)
 
     def write(self, kind: str, **fields) -> dict:
+        """One validated, durably-flushed record. The physical write is
+        the ``ledger-write`` transient seam (engine/faults.py registry):
+        a failed flush/fsync (full disk, injected fault) takes ONE
+        bounded retry, then DEGRADES — the record is dropped with a
+        stderr note and a ``write_failures`` count, because losing one
+        evidence record must never kill the campaign writing it. The
+        loader's torn-line tolerance absorbs any partial line a failed
+        attempt left."""
         rec = {"v": LEDGER_VERSION, "kind": kind, "t": round(time.time(), 3)}
         rec.update(fields)
         _validate(rec, 0)
         line = json.dumps(rec, sort_keys=True)
-        with self._lock:
-            if self._closed:
-                return rec
-            self._f.write(line + "\n")
-            self._f.flush()
-            try:
-                os.fsync(self._f.fileno())
-            except (OSError, io.UnsupportedOperation):
-                pass                     # pipes/pytest capture: flush is all
+        F = _faults_mod()
+
+        def emit():
+            F.fault_point("ledger-write", detail=kind)
+            with self._lock:
+                if self._closed:
+                    return
+                self._f.write(line + "\n")
+                self._f.flush()
+                try:
+                    os.fsync(self._f.fileno())
+                except (OSError, io.UnsupportedOperation):
+                    pass                 # pipes/pytest capture: flush is all
+
+        try:
+            F.with_retry("ledger-write", emit)
+        except (OSError, F.FaultError) as exc:
+            F.record_fault_event("ledger-write", "degrade",
+                                 detail=str(exc)[:200])
+            self.write_failures += 1
+            print(f"# ledger write failed ({exc}); record dropped, "
+                  "campaign continues", file=sys.stderr)
         return rec
 
     def meta(self, **fields) -> dict:
@@ -368,13 +417,34 @@ class Heartbeat:
         self.status = status
         self.out = sys.stderr if out is Heartbeat._STDERR else out
         self.beats = 0
+        self._survived = 0       # beat() exceptions the loop outlived
         self._stop = threading.Event()
         self._thread = None
         self._t0 = None
 
     def _loop(self):
         while not self._stop.wait(self.interval_s):
-            self.beat()
+            try:
+                self.beat()
+            except Exception as exc:
+                # the liveness thread must outlive its own bugs: a beat
+                # that raised records a progress NOTE (best effort) and
+                # the loop continues — a silently dead heartbeat would
+                # un-detect the very hangs it exists to surface
+                self._survived += 1
+                try:
+                    if self.ledger is not None:
+                        self.ledger.progress(
+                            note="heartbeat-exception",
+                            error=f"{type(exc).__name__}: {exc}"[:200])
+                except Exception:
+                    pass
+                if self.out is not None:
+                    try:
+                        print(f"# heartbeat survived {type(exc).__name__}:"
+                              f" {exc}", file=self.out, flush=True)
+                    except Exception:
+                        pass
 
     def beat(self) -> dict:
         """One heartbeat (also callable directly, e.g. from tests)."""

@@ -59,6 +59,16 @@ def maybe_initialize() -> bool:
         return True
     if not os.environ.get("NDS_TPU_MULTIHOST"):
         return False
+    from nds_tpu.engine import faults as _F
+    try:
+        # federation-peer seam (fatal): a refused/failed peer attach
+        # raises a CLASSIFIED error promptly — a half-formed federation
+        # must never run a collective, and no silent retry loop may
+        # mask a dead coordinator
+        _F.fault_point("peer")
+    except _F.FaultInjected as exc:
+        _F.record_fault_event("peer", "fatal", detail=str(exc)[:200])
+        raise
     import jax
     impl = os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION")
     if impl:
@@ -76,7 +86,10 @@ def maybe_initialize() -> bool:
         kwargs["num_processes"] = int(os.environ["NDS_NUM_PROCESSES"])
     if os.environ.get("NDS_PROCESS_ID"):
         kwargs["process_id"] = int(os.environ["NDS_PROCESS_ID"])
-    jax.distributed.initialize(**kwargs)
+    # the attach blocks on the coordinator and every peer; under
+    # NDS_TPU_STATEMENT_DEADLINE_S a stuck peer raises StatementTimeout
+    # (classified, status 'timeout') instead of hanging the process
+    _F.bounded_call("peer", lambda: jax.distributed.initialize(**kwargs))
     _initialized = True
     return True
 

@@ -274,6 +274,16 @@ def run_query_stream(input_prefix: str,
             from nds_tpu.listener import stream_event_json
             q_report.summary["streamedScans"] = [
                 stream_event_json(e) for e in stream_events]
+        # fault-recovery evidence (engine/faults.py): retries, ladder
+        # degradations and watchdog timeouts this query survived — the
+        # reference's task-failure-listener idea applied to the
+        # engine's own recovery paths, ridden into the ledger
+        from nds_tpu.engine.faults import (drain_fault_events,
+                                           fault_event_json)
+        fault_events = drain_fault_events()
+        if fault_events:
+            q_report.summary["faultEvents"] = [
+                fault_event_json(e) for e in fault_events]
         # per-phase trace rollup (nds_tpu/obs): where the query's wall
         # went — plan, stream record/compile/drive, materialize — plus
         # the top sync-charging host-read sites; the full span tree goes
@@ -349,12 +359,18 @@ def run_query_stream(input_prefix: str,
             # ledger writer
             rec = {"ms": elapsed, "phase": q_report.summary["phase"]}
             for k in ("hostSyncs", "syncWaitMs", "scanBytes", "scanGBps",
-                      "compileMs", "execMs", "streamedScans"):
+                      "compileMs", "execMs", "streamedScans",
+                      "faultEvents"):
                 if k in q_report.summary:
                     rec[k] = q_report.summary[k]
             if "trace" in q_report.summary:
                 rec["tracePhases"] = q_report.summary["trace"]
             status = "ok" if q_report.is_success() else "error"
+            if status == "error" and any(
+                    e.action == "timeout" for e in fault_events):
+                # the statement watchdog fired inside this query: the
+                # classified status is `timeout` (the run continued)
+                status = "timeout"
             if status == "error" and q_report.summary["exceptions"]:
                 rec["error"] = str(q_report.summary["exceptions"][-1])[:300]
             ledger.query(query_name, status=status, **rec)

@@ -524,6 +524,80 @@ def test_jax_lint_cache_setdefault_counts_as_write(tmp_path):
     assert not fs, "\n".join(str(f) for f in fs)
 
 
+def test_jax_lint_swallowed_fault(tmp_path):
+    """An except clause catching a classified fault (FaultError family,
+    bare / attribute-qualified / inside a tuple) must record a
+    FaultEvent or re-raise — anything else is an un-auditable recovery
+    (DESIGN.md 'Fault-tolerance contract')."""
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import faults as _F
+        def recover(fn):
+            try:
+                return fn()
+            except _F.FaultInjected:
+                return None                      # swallowed: flagged
+        def recover2(fn):
+            try:
+                return fn()
+            except (OSError, _F.FaultError) as exc:
+                log(exc)                         # swallowed: flagged
+        def recover3(fn):
+            try:
+                return fn()
+            except FaultInjected:
+                pass                             # bare name: flagged
+    """, rel="nds_tpu/engine/stream.py")
+    assert [f.rule for f in fs] == ["swallowed-fault"] * 3
+    assert all(f.severity == "error" for f in fs)
+
+
+def test_jax_lint_swallowed_fault_compliant_ok(tmp_path):
+    # recording the event, re-raising, or raising a classified
+    # replacement all comply; unrelated except clauses never trip
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import faults as _F
+        def recover(fn):
+            try:
+                return fn()
+            except _F.FaultInjected as exc:
+                _F.record_fault_event(exc.seam, "degrade")
+                return None
+        def reraise(fn):
+            try:
+                return fn()
+            except _F.StatementTimeout:
+                raise
+        def classify(fn):
+            try:
+                return fn()
+            except _F.FaultError as exc:
+                raise RuntimeError("classified") from exc
+        def unrelated(fn):
+            try:
+                return fn()
+            except ValueError:
+                return None
+    """, rel="nds_tpu/engine/stream.py")
+    assert not fs, "\n".join(str(f) for f in fs)
+
+
+def test_jax_lint_swallowed_fault_suppression_and_tree_clean(tmp_path):
+    fs = lint_snippet(tmp_path, """
+        from nds_tpu.engine import faults as _F
+        def recover(fn):
+            try:
+                return fn()
+            # nds-lint: ignore[swallowed-fault]
+            except _F.FaultInjected:
+                return None
+    """, rel="nds_tpu/engine/stream.py")
+    assert not fs
+    # the real tree's recovery paths all comply (baseline untouched)
+    from nds_tpu.analysis.jax_lint import lint_tree
+    got = [f for f in lint_tree() if f.rule == "swallowed-fault"]
+    assert not got, "\n".join(str(f) for f in got)
+
+
 def test_jax_lint_chunk_loop_host_sync(tmp_path):
     # in ANY module (not just hot-path files): a sync per streamed chunk
     # is the O(chunks) cost the compiled executor removes
@@ -1796,7 +1870,11 @@ def test_lint_changed_covers_kernels():
               # chunk store (the streamed wire format) rerun the
               # corpus passes on edit
               "nds_tpu/engine/prefetch.py",
-              "nds_tpu/io/chunk_store.py"):
+              "nds_tpu/io/chunk_store.py",
+              # fault-tolerance layer: seam/classification edits move
+              # exec_audit's retry-paths row and the swallowed-fault
+              # contract
+              "nds_tpu/engine/faults.py"):
         assert p.startswith(mod._CORPUS_ROOTS), \
             f"{p} not covered by _CORPUS_ROOTS"
 

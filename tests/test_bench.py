@@ -540,3 +540,179 @@ def test_collect_sf10_failure_capture_excludes_restart_suffix(tmp_path):
     assert doc["failures"]["query9"] == "(timeout after 600s)"
     assert doc["failures"]["query70"] == "ExecError boom"
     assert doc["failures"]["query88"] == "plain failure line"
+
+
+def test_restart_backoff_deterministic_and_jittered(monkeypatch):
+    """The jittered backoff between child restarts (the bench-child
+    seam's spacing policy): zero before the FIRST start, exponential +
+    deterministic hash-jitter afterwards — the same index always yields
+    the same delay (tests and wall bounds hold), 0 disables."""
+    monkeypatch.setenv("NDS_BENCH_RESTART_BACKOFF_S", "1.0")
+    assert bench.restart_backoff_s(1) == 0.0
+    b2, b3, b4 = (bench.restart_backoff_s(n) for n in (2, 3, 4))
+    assert 1.0 <= b2 <= 1.5 and 2.0 <= b3 <= 3.0 and 4.0 <= b4 <= 6.0
+    assert bench.restart_backoff_s(2) == b2, "jitter must be deterministic"
+    assert bench.restart_backoff_s(20) <= 30.0, "backoff must cap"
+    monkeypatch.setenv("NDS_BENCH_RESTART_BACKOFF_S", "0")
+    assert bench.restart_backoff_s(5) == 0.0
+
+
+def test_restart_backoff_applied_between_restarts(monkeypatch, capsys):
+    """The parent loop backs off (visibly) between consecutive child
+    restarts before the 2-strike breaker trips."""
+    monkeypatch.setenv("NDS_BENCH_RESTART_BACKOFF_S", "0.01")
+
+    class DeadChild:
+        def __init__(self):
+            self.proc = None
+
+        def alive(self):
+            return False
+
+        def start(self, deadline_left):
+            return None
+
+        def stop(self):
+            pass
+
+    monkeypatch.setattr(bench, "ChildServer", DeadChild)
+    monkeypatch.setattr(bench, "ensure_data", lambda: None)
+    monkeypatch.setattr(bench, "bench_queries",
+                        lambda: [("query1", "select 1")])
+    monkeypatch.setattr(bench, "_emitted", False)
+    import time as _time
+    with pytest.raises(SystemExit):
+        bench.run_parent(_time.perf_counter())
+    err = capsys.readouterr().err
+    assert "backing off" in err, "no backoff between restarts"
+    assert "failing fast" in err, "breaker must still trip"
+
+
+def test_bench_child_fault_injection_degrades_to_restart_path(monkeypatch):
+    """The bench-child seam: an injected start fault takes the same path
+    as a real setup failure (start returns None — the caller's backoff +
+    breaker own the recovery) and records the FaultEvent."""
+    F = bench.faults_mod()
+    F.reset_fault_counts()
+    F.drain_fault_events()
+    monkeypatch.setenv("NDS_TPU_FAULT", "bench-child:error:1")
+    try:
+        cs = bench.ChildServer()
+        assert cs.start(5.0) is None, "injected start fault must degrade"
+        events = F.drain_fault_events()
+        assert [(e.seam, e.action) for e in events] == \
+            [("bench-child", "degrade")], events
+    finally:
+        F.reset_fault_counts()
+
+
+def test_heartbeat_survives_beat_exception(tmp_path):
+    """A heartbeat-thread exception must record a ledger progress note
+    and CONTINUE beating — a silently dead liveness thread would
+    un-detect the very hangs it exists to surface."""
+    import time as _time
+    lm = bench.ledger_mod()
+    path = str(tmp_path / "l.jsonl")
+    led = lm.Ledger(path, driver="bench")
+    hb = lm.Heartbeat(0.05, ledger=led, out=None)
+    orig = led.progress
+    calls = {"n": 0}
+
+    def flaky(**fields):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("beat bug")      # escapes beat()
+        return orig(**fields)
+
+    led.progress = flaky
+    hb.start()
+    deadline = _time.monotonic() + 10.0
+    while (hb.beats < 3 or hb._survived < 1) and \
+            _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    hb.stop()
+    led.close(None)
+    assert hb._survived >= 1, "loop never saw the exception"
+    assert hb.beats >= 3, "heartbeat died instead of continuing"
+    recs = [rec for _ln, rec in lm.iter_ledger(path)
+            if rec["kind"] == "progress"]
+    notes = [r for r in recs if r.get("note") == "heartbeat-exception"]
+    assert notes and "beat bug" in notes[0]["error"], \
+        "exception note must land in the ledger"
+    assert any("beat" in r for r in recs if r is not notes[0]), \
+        "beats must continue after the note"
+
+
+def test_ledger_write_fault_retries_then_degrades(tmp_path, monkeypatch):
+    """The ledger-write seam: one injected write fault recovers through
+    the bounded retry (record lands, zero write_failures); a persistent
+    failure degrades — record dropped with a note, campaign continues."""
+    lm = bench.ledger_mod()
+    F = bench.faults_mod()
+    path = str(tmp_path / "l.jsonl")
+    led = lm.Ledger(path, driver="bench")
+    F.reset_fault_counts()
+    monkeypatch.setenv("NDS_TPU_FAULT", "ledger-write:error:1")
+    led.query("query1", status="ok", ms=1.0)
+    monkeypatch.delenv("NDS_TPU_FAULT")
+    F.reset_fault_counts()
+    assert led.write_failures == 0, "one injected fault must retry clean"
+    data = lm.load_ledger(path)
+    assert "query1" in data.queries, "retried record must persist"
+    # persistent failure: every attempt raises -> degrade, keep serving
+    real_open_write = led._f.write
+
+    def broken(_s):
+        raise OSError("disk full")
+
+    led._f.write = broken
+    led.query("query2", status="ok", ms=2.0)
+    assert led.write_failures == 1, "persistent failure must degrade"
+    led._f.write = real_open_write
+    led.query("query3", status="ok", ms=3.0)
+    led.close("completed")
+    data = lm.load_ledger(path)
+    assert "query3" in data.queries and "query2" not in data.queries
+
+
+def test_server_error_result_drains_fault_events():
+    """The serving loop's FAILURE path must drain the thread's fault
+    ring into the failed query's own result line: left behind, a failed
+    query's events (incl. the watchdog's `timeout`) would misattribute
+    to the NEXT query's success-path drain."""
+    from nds_tpu.engine import faults as F
+    F.drain_fault_events()
+    F.record_fault_event("sync", "timeout", detail="blocked")
+    out = bench.error_result("query9", F.StatementTimeout("sync", "late"))
+    assert out["timeout"] is True
+    assert [e["seam"] for e in out["faultEvents"]] == ["sync"]
+    assert not F.drain_fault_events(), \
+        "the failure path must leave the ring EMPTY for the next query"
+    # and a plain error with no events carries neither key
+    out2 = bench.error_result("query10", ValueError("boom"))
+    assert "faultEvents" not in out2 and "timeout" not in out2
+
+
+def test_drain_parent_faults_ledgers_bench_child_events(tmp_path):
+    """bench-child seam evidence is recorded in the PARENT's ring (the
+    child is the thing that failed): run_parent's drain must land it in
+    the campaign ledger as a progress note, not let it die in the
+    ring."""
+    lm = bench.ledger_mod()
+    F = bench.faults_mod()
+    F.drain_fault_events()
+    path = str(tmp_path / "l.jsonl")
+    led = lm.Ledger(path, driver="bench")
+    F.record_fault_event("bench-child", "degrade", detail="injected")
+    events = bench.drain_parent_faults(led)
+    led.close(None)
+    assert [(e.seam, e.action) for e in events] == \
+        [("bench-child", "degrade")]
+    assert not F.drain_fault_events(), "ring must be drained"
+    recs = [rec for _ln, rec in lm.iter_ledger(path)
+            if rec["kind"] == "progress"]
+    (note,) = [r for r in recs if r.get("note") == "fault-event"]
+    assert note["seam"] == "bench-child" and note["action"] == "degrade"
+    # ledger off: events still drain (no misattribution), none written
+    F.record_fault_event("bench-child", "degrade")
+    assert len(bench.drain_parent_faults(None)) == 1

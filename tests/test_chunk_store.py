@@ -4,10 +4,14 @@
 Round-trip: a warm run must slice mmapped wire arrays into the SAME
 padded chunks (bit-for-bit query results) without touching arrow
 slicing or codec planning. Edges per the store contract: version gate
-and checksum mismatch REFUSED loudly (ChunkStoreError, never silently
-served), a stale codec plan (data changed under the same shape)
-INVALIDATES silently (miss -> re-encode -> overwrite), and empty /
-single-row tables round-trip.
+REFUSED loudly (ChunkStoreError — fatal), a corrupt entry (checksum
+mismatch) refused at load_plan but RECOVERED on the engine path
+(delete + re-encode from source, FaultEvent evidence — the
+chunk-store-read seam), a stale codec plan (data changed under the
+same shape) INVALIDATES silently (miss -> re-encode -> overwrite),
+empty / single-row tables round-trip, and a writer KILLED mid-write
+leaves the slot old-valid-or-none with a stale lock the next writer
+steals (the chunk-store-write seam).
 """
 
 import json
@@ -111,11 +115,7 @@ def test_store_version_gate_refused_loudly(tmp_path, monkeypatch):
         _run(tbl)
 
 
-def test_store_checksum_mismatch_refused_loudly(tmp_path, monkeypatch):
-    tbl = _table()
-    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
-    _run(tbl)
-    entry = _entry(str(tmp_path))
+def _corrupt_entry(entry):
     (data0,) = [f for f in sorted(os.listdir(entry))
                 if f.endswith("000.data.npy")]
     p = os.path.join(entry, data0)
@@ -124,8 +124,37 @@ def test_store_checksum_mismatch_refused_loudly(tmp_path, monkeypatch):
         b = f.read(1)
         f.seek(-1, 2)
         f.write(bytes([b[0] ^ 0xFF]))
-    with pytest.raises(CS.ChunkStoreError, match="checksum mismatch"):
-        _run(tbl)
+
+
+def test_store_checksum_mismatch_refused_then_recovered(tmp_path,
+                                                        monkeypatch):
+    """Two halves of the corrupt-entry contract (DESIGN.md
+    "Fault-tolerance contract", chunk-store-read seam): a DIRECT
+    load_plan refuses the corrupt entry loudly (ChunkStoreCorrupt —
+    corrupt codes are never handed out), while the ENGINE path recovers
+    by deleting + re-encoding from source — correct rows, a recorded
+    FaultEvent, and a fresh valid entry on disk."""
+    from nds_tpu.engine import faults as F
+    tbl = _table()
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", str(tmp_path))
+    expect = _run(tbl)
+    entry = _entry(str(tmp_path))
+    # the store entry is keyed to the PRUNED scan (the query's column
+    # set, in plan order) — read that identity off the manifest
+    pruned = tbl.select([c["name"] for c in json.load(
+        open(os.path.join(entry, "manifest.json")))["columns"]])
+    _corrupt_entry(entry)
+    with pytest.raises(CS.ChunkStoreCorrupt, match="checksum mismatch"):
+        CS.load_plan(str(tmp_path), pruned, {})
+    F.drain_fault_events()
+    got = _run(tbl)
+    assert got == expect and got, "recovery changed the results"
+    events = F.drain_fault_events()
+    assert [e.seam for e in events] == ["chunk-store-read"], events
+    assert events[0].action == "recovered"
+    # the slot was re-encoded whole: a further warm run loads clean
+    assert CS.load_plan(str(tmp_path), pruned, {}) is not None
+    assert _run(tbl) == expect
 
 
 def test_store_stale_codec_plan_invalidates(tmp_path, monkeypatch):
@@ -191,3 +220,130 @@ def test_store_and_ring_compose(tmp_path, monkeypatch):
         from nds_tpu.engine import stream
         stream.reset_pipeline_cache()
         assert _run(tbl) == base, f"store+ring divergence at depth {depth}"
+
+
+def test_store_killed_writer_leaves_valid_state_and_stale_lock_steals(
+        tmp_path, monkeypatch):
+    """Concurrent-writer safety (chunk-store-write seam): a writer
+    process SIGKILLed mid-write must leave the entry slot either
+    old-valid or absent — never a half entry the loader would trust —
+    plus a stale lock file that the next writer steals by pid liveness,
+    after which the slot persists clean and loads bit-for-bit."""
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    import pyarrow.parquet as pq
+
+    tbl = _table(n=2000)
+    src = str(tmp_path / "src.parquet")
+    pq.write_table(tbl, src)
+    root = str(tmp_path / "store")
+    script = (
+        "import os, sys\n"
+        "import pyarrow.parquet as pq\n"
+        f"sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})\n"
+        "from nds_tpu.engine.table import ChunkedTable\n"
+        f"tbl = pq.read_table({src!r})\n"
+        "ct = ChunkedTable(tbl, chunk_rows=800)\n"
+        "plan = ct._build_wire_plan()\n"
+        "from nds_tpu.io import chunk_store as CS\n"
+        "print('SAVING', flush=True)\n"
+        f"CS.save_plan({root!r}, tbl, {{}}, plan)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               # hang-kind injection parks the writer BETWEEN buffer
+               # writes (after the first column's .npy landed in the
+               # temp dir) — the deterministic mid-write kill point
+               NDS_TPU_FAULT="chunk-store-write:hang:1",
+               NDS_TPU_FAULT_HANG_S="60")
+    proc = subprocess.Popen([sys.executable, "-c", script],
+                            stdout=subprocess.PIPE, text=True, env=env)
+    assert proc.stdout.readline().strip() == "SAVING"
+    _time.sleep(1.0)                       # inside the injected hang
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+    # the slot: no entry directory was ever swapped in (old-valid-or-
+    # none; here: none), only the temp dir + the stale lock remain
+    entries = [d for d in os.listdir(root) if not d.startswith(".")
+               and not d.endswith(".lock")]
+    assert entries == [], f"killed writer left a half entry: {entries}"
+    locks = [d for d in os.listdir(root) if d.endswith(".lock")]
+    assert len(locks) == 1, "killed writer should leave its lock behind"
+    # a fresh writer steals the dead pid's lock and lands a whole entry
+    monkeypatch.delenv("NDS_TPU_FAULT", raising=False)
+    from nds_tpu.engine.table import ChunkedTable as CT
+    ct = CT(tbl, chunk_rows=800)
+    out = CS.save_plan(root, tbl, {}, ct._build_wire_plan())
+    assert out is not None, "stale lock was not stolen"
+    assert not os.path.exists(out + ".lock"), "lock not released"
+    assert CS.load_plan(root, tbl, {}) is not None
+    # and the store now serves queries bit-for-bit
+    expect = _run(tbl)
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE", root)
+    assert _run(tbl) == expect
+
+
+def test_store_live_writer_lock_is_respected(tmp_path):
+    """Two processes warming one store directory cannot interleave: while
+    a LIVE writer holds the entry lock, a second save_plan skips (returns
+    None) and the caller serves its in-memory plan."""
+    tbl = _table(n=256)
+    root = str(tmp_path)
+    ct = ChunkedTable(tbl, chunk_rows=128)
+    plan = ct._build_wire_plan()
+    final = CS._entry_dir(root, tbl, {})
+    os.makedirs(root, exist_ok=True)
+    lock = CS._acquire_entry_lock(final)
+    assert lock is not None
+    try:
+        assert CS.save_plan(root, tbl, {}, plan) is None, \
+            "second writer must yield to a live lock holder"
+    finally:
+        os.unlink(lock)
+    assert CS.save_plan(root, tbl, {}, plan) is not None
+
+
+def test_store_unstamped_lock_not_stolen_until_age(tmp_path, monkeypatch):
+    """An UNSTAMPED lock (a writer caught between its O_EXCL create and
+    its pid write) must not be treated as dead-on-arrival: only the age
+    bound may steal it — stealing by the unreadable pid would unlink a
+    live writer's fresh lock and let two writers interleave in one
+    slot."""
+    tbl = _table(n=256)
+    root = str(tmp_path)
+    ct = ChunkedTable(tbl, chunk_rows=128)
+    plan = ct._build_wire_plan()
+    final = CS._entry_dir(root, tbl, {})
+    os.makedirs(root, exist_ok=True)
+    open(final + ".lock", "w").close()          # empty: pid never landed
+    assert CS.save_plan(root, tbl, {}, plan) is None, \
+        "a fresh unstamped lock must be honored, not stolen"
+    # ... but past the staleness age it IS reclaimed (a kill in that
+    # window must not wedge the slot forever)
+    monkeypatch.setenv("NDS_TPU_CHUNK_STORE_LOCK_STALE_S", "0")
+    assert CS.save_plan(root, tbl, {}, plan) is not None
+    assert not os.path.exists(final + ".lock")
+    assert CS.load_plan(root, tbl, {}) is not None
+
+
+def test_store_lock_release_is_ownership_checked(tmp_path):
+    """A writer whose lock was stolen (age bound) must NOT unlink the
+    stealer's lock on its way out — only a lock still holding our own
+    pid is released."""
+    tbl = _table(n=256)
+    root = str(tmp_path)
+    plan = ChunkedTable(tbl, chunk_rows=128)._build_wire_plan()
+    final = CS._entry_dir(root, tbl, {})
+    os.makedirs(root, exist_ok=True)
+    # simulate the steal: the slot's lock belongs to someone else now
+    with open(final + ".lock", "w") as f:
+        f.write("999999")
+    CS._release_entry_lock(final + ".lock")
+    assert os.path.exists(final + ".lock"), \
+        "released a lock that was not ours"
+    os.unlink(final + ".lock")
+    # the normal path still releases its own lock
+    assert CS.save_plan(root, tbl, {}, plan) is not None
+    assert not os.path.exists(final + ".lock")

@@ -1,0 +1,349 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Fault registry + recovery-policy layer (engine/faults.py) and its
+differential harness (tools/fault_diff.py).
+
+Unit contract: deterministic ``NDS_TPU_FAULT=seam:kind:nth`` parsing and
+single-fire occurrence counting, bounded transient retry (non-transient
+errors propagate untouched on the first attempt), the statement
+watchdog's classified ``StatementTimeout``, thread-scoped FaultEvent
+drains. Matrix contract: every registered seam has >=1 tier-1 injection
+(this file asserts the registry/matrix union), the full matrix recovers
+bit-for-bit or raises classified errors within deadline, and the
+``--inject-drift`` self-test proves the gate can fail.
+"""
+
+import importlib.util
+import os
+import threading
+import time
+
+import pytest
+
+from nds_tpu.engine import faults as F
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fault_diff():
+    spec = importlib.util.spec_from_file_location(
+        "fault_diff_tool", os.path.join(REPO, "tools", "fault_diff.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    F.reset_fault_counts()
+    F.drain_fault_events()
+    yield
+    F.reset_fault_counts()
+    F.drain_fault_events()
+
+
+# ---------------------------------------------------------------------------
+# registry + injection spec
+# ---------------------------------------------------------------------------
+
+
+def test_registry_names_every_seam_with_policy():
+    """Every seam carries a classification and a recovery policy; the
+    transient ones declare a bounded retry allowance and the exception
+    set the retry treats as transient."""
+    assert F.SEAMS, "registry must not be empty"
+    for s in F.SEAMS.values():
+        assert s.classify in (F.TRANSIENT, F.DEGRADABLE, F.FATAL), s
+        assert s.recovery and s.where, s
+        if s.retry_on:
+            assert s.classify is not F.FATAL, \
+                f"{s.name}: a fatal seam must not silently retry"
+
+
+def test_fault_spec_parsing(monkeypatch):
+    monkeypatch.delenv("NDS_TPU_FAULT", raising=False)
+    assert F.fault_spec() is None
+    monkeypatch.setenv("NDS_TPU_FAULT", "sync")
+    assert F.fault_spec() == ("sync", "error", 1)
+    monkeypatch.setenv("NDS_TPU_FAULT", "prefetch:hang:3")
+    assert F.fault_spec() == ("prefetch", "hang", 3)
+    monkeypatch.setenv("NDS_TPU_FAULT", "no-such-seam:error:1")
+    with pytest.raises(ValueError, match="unregistered seam"):
+        F.fault_spec()                   # a typo must never pass vacuously
+    monkeypatch.setenv("NDS_TPU_FAULT", "sync:explode:1")
+    with pytest.raises(ValueError, match="kind"):
+        F.fault_spec()
+
+
+def test_fault_point_fires_exactly_once_at_nth(monkeypatch):
+    monkeypatch.setenv("NDS_TPU_FAULT", "sync:error:2")
+    F.fault_point("sync")                # occurrence 1: no fire
+    with pytest.raises(F.FaultInjected):
+        F.fault_point("sync")            # occurrence 2: fires
+    F.fault_point("sync")                # occurrence 3+: never again
+    F.fault_point("sync")
+    assert F.fired_count("sync") == 4
+    F.fault_point("prefetch")            # untargeted seam: free
+    assert F.fired_count("prefetch") == 0
+
+
+def test_fault_point_occurrences_deterministic_under_threads(monkeypatch):
+    """Concurrent threads agree on nth: exactly ONE raises."""
+    monkeypatch.setenv("NDS_TPU_FAULT", "sync:error:5")
+    raised = []
+    barrier = threading.Barrier(4)
+
+    def worker():
+        barrier.wait()
+        for _ in range(10):
+            try:
+                F.fault_point("sync")
+            except F.FaultInjected:
+                raised.append(1)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(raised) == 1, "exactly one occurrence must fire"
+    assert F.fired_count("sync") == 40
+
+
+# ---------------------------------------------------------------------------
+# bounded retry
+# ---------------------------------------------------------------------------
+
+
+def test_with_retry_recovers_transient_and_records_once():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise F.FaultInjected("sync", "transient flake")
+        return 42
+
+    assert F.with_retry("sync", flaky) == 42
+    events = F.drain_fault_events()
+    assert [(e.seam, e.action, e.attempt) for e in events] == \
+        [("sync", "recovered", 1)]
+
+
+def test_with_retry_propagates_non_transient_first_attempt():
+    calls = {"n": 0}
+
+    def bug():
+        calls["n"] += 1
+        raise ValueError("engine bug")
+
+    with pytest.raises(ValueError, match="engine bug"):
+        F.with_retry("prefetch", bug)
+    assert calls["n"] == 1, "a retry loop must never mask an engine bug"
+    assert not F.drain_fault_events()
+
+
+def test_with_retry_exhaustion_reraises_classified():
+    seam = F.SEAMS["sync"]
+
+    def always():
+        raise F.FaultInjected("sync", "persistent")
+
+    with pytest.raises(F.FaultInjected, match="persistent"):
+        F.with_retry("sync", always)
+    # attempts = retries + 1, no recovered event
+    assert not [e for e in F.drain_fault_events()
+                if e.action == "recovered"]
+    assert seam.retries >= 1
+
+
+def test_with_retry_drift_suppresses_recovery(monkeypatch):
+    """NDS_TPU_FAULT_DRIFT (the --inject-drift knob): no retry, no
+    event — the harness's recovery checks must then fail."""
+    monkeypatch.setenv("NDS_TPU_FAULT_DRIFT", "1")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        raise F.FaultInjected("sync", "flake")
+
+    with pytest.raises(F.FaultInjected):
+        F.with_retry("sync", flaky)
+    assert calls["n"] == 1, "drift must suppress the retry"
+    F.record_fault_event("sync", "recovered")
+    monkeypatch.delenv("NDS_TPU_FAULT_DRIFT")
+    assert not F.drain_fault_events(), "drift must suppress recording"
+
+
+# ---------------------------------------------------------------------------
+# statement watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_call_inline_without_deadline(monkeypatch):
+    monkeypatch.delenv("NDS_TPU_STATEMENT_DEADLINE_S", raising=False)
+    tid = []
+    assert F.bounded_call("sync",
+                          lambda: tid.append(threading.get_ident()) or 7) \
+        == 7
+    assert tid == [threading.get_ident()], \
+        "watchdog off must mean inline (zero threads)"
+
+
+def test_bounded_call_times_out_classified(monkeypatch):
+    monkeypatch.setenv("NDS_TPU_STATEMENT_DEADLINE_S", "0.3")
+    t0 = time.monotonic()
+    with pytest.raises(F.StatementTimeout):
+        F.bounded_call("sync", lambda: time.sleep(10))
+    assert time.monotonic() - t0 < 5, "timeout must beat the hang"
+    events = F.drain_fault_events()
+    assert [(e.seam, e.action) for e in events] == [("sync", "timeout")]
+
+
+def test_bounded_call_charges_one_statement_budget(monkeypatch):
+    """Inside a statement scope, waits share ONE budget: after the
+    clock runs out, the next wait times out immediately."""
+    monkeypatch.setenv("NDS_TPU_STATEMENT_DEADLINE_S", "0.4")
+    with F.statement_scope():
+        assert F.bounded_call("sync", lambda: 1) == 1
+        time.sleep(0.5)                  # exhaust the statement budget
+        t0 = time.monotonic()
+        with pytest.raises(F.StatementTimeout, match="exhausted"):
+            F.bounded_call("sync", lambda: time.sleep(5))
+        assert time.monotonic() - t0 < 1.0
+    F.drain_fault_events()
+
+
+def test_bounded_call_propagates_helper_exception(monkeypatch):
+    monkeypatch.setenv("NDS_TPU_STATEMENT_DEADLINE_S", "5")
+
+    def boom():
+        raise KeyError("inner")
+
+    with pytest.raises(KeyError, match="inner"):
+        F.bounded_call("sync", boom)
+
+
+def test_statement_scope_reentrant_keeps_outer_clock():
+    with F.statement_scope():
+        start = F._stmt_tls.start
+        with F.statement_scope():
+            assert F._stmt_tls.start == start, \
+                "nested statements must keep the OUTER clock"
+        assert F._stmt_tls.start == start
+    assert getattr(F._stmt_tls, "start", None) is None
+
+
+def test_fault_events_thread_scoped():
+    F.record_fault_event("sync", "recovered")
+    got = {}
+
+    def other():
+        got["events"] = F.drain_fault_events()
+
+    t = threading.Thread(target=other)
+    t.start()
+    t.join()
+    assert got["events"] == [], "events must not bleed across threads"
+    assert len(F.drain_fault_events()) == 1
+
+
+def test_fault_event_json_shape():
+    e = F.FaultEvent("prefetch", "recovered", attempt=1, detail="x" * 300)
+    j = F.fault_event_json(e)
+    assert j["seam"] == "prefetch" and j["action"] == "recovered"
+    assert j["attempt"] == 1 and len(j["detail"]) == 200
+    assert F.fault_event_json(F.FaultEvent("sync", "timeout")) == \
+        {"seam": "sync", "action": "timeout"}
+
+
+# ---------------------------------------------------------------------------
+# the matrix: every seam injected, recoveries proven, drift must fail
+# ---------------------------------------------------------------------------
+
+
+def test_registry_fully_covered_by_injection_matrix():
+    """A NEW seam cannot land without a tier-1 injection: the union of
+    fault_diff's matrix and the named elsewhere-covered tests must equal
+    the registry."""
+    import ast
+    src = open(os.path.join(REPO, "tools", "fault_diff.py")).read()
+    injected = set()
+    for node in ast.walk(ast.parse(src)):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and ":" in node.value:
+            seam = node.value.split(":")[0]
+            if seam in F.SEAMS:
+                injected.add(seam)
+    mod = _fault_diff()
+    covered = injected | set(mod.COVERED_ELSEWHERE)
+    missing = set(F.SEAMS) - covered
+    assert not missing, \
+        f"registered seams with no tier-1 injection: {sorted(missing)}"
+
+
+def test_fault_diff_matrix_green():
+    """The full injection matrix: every seam recovers bit-for-bit or
+    raises its classified error within the deadline."""
+    failures = _fault_diff().run_diff(verbose=False)
+    assert not failures, "\n".join(failures)
+
+
+def test_fault_diff_inject_drift_must_fail():
+    """Recovery suppression (NDS_TPU_FAULT_DRIFT) must be CAUGHT: a gate
+    that passes with the recovery machinery disabled is vacuous."""
+    failures = _fault_diff().run_diff(inject_drift=True, verbose=False)
+    assert failures, "drift fixture failed to fail"
+
+
+# ---------------------------------------------------------------------------
+# driver wiring: FaultEvents ride the campaign ledger
+# ---------------------------------------------------------------------------
+
+
+def test_power_ledger_carries_fault_events(tmp_path, monkeypatch):
+    """A recovery that fires during a Power query lands as
+    ``faultEvents`` in the query's ledger record (and JSON summary) —
+    failure evidence is benchmark evidence, not log noise."""
+    import json
+    from collections import OrderedDict
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from nds_tpu import power
+    from nds_tpu.obs.ledger import load_ledger
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.types import to_arrow as to_pa
+    fields = get_schemas(use_decimal=True)["item"]
+    monkeypatch.setattr(power, "get_schemas",
+                        lambda use_decimal: {"item": fields})
+    data = tmp_path / "data"
+    (data / "item").mkdir(parents=True)
+    cols = {f.name: pa.array([None, None], to_pa(f.type)) for f in fields}
+    cols["i_item_sk"] = pa.array([1, 2], to_pa(fields[0].type))
+    pq.write_table(pa.table(cols), data / "item" / "part-0.parquet")
+    ledger_path = tmp_path / "campaign.jsonl"
+    jdir = tmp_path / "json"
+    monkeypatch.setenv("NDS_TPU_FAULT", "sync:error:1")
+    F.reset_fault_counts()
+    F.drain_fault_events()
+    # a filtered+ordered projection resolves its output count through
+    # the guarded blocking fetch — the sync seam is guaranteed to fire
+    power.run_query_stream(str(data), None,
+                           OrderedDict(q="select i_item_sk from item "
+                                         "where i_item_sk > 0 "
+                                         "order by i_item_sk"),
+                           str(tmp_path / "t.csv"),
+                           json_summary_folder=str(jdir),
+                           ledger_path=str(ledger_path))
+    monkeypatch.delenv("NDS_TPU_FAULT")
+    F.reset_fault_counts()
+    led = load_ledger(str(ledger_path))
+    rec = led.queries["q"]
+    assert rec["status"] == "ok", "the transient fault must recover"
+    assert rec.get("faultEvents"), "recovery evidence missing from ledger"
+    (ev,) = [e for e in rec["faultEvents"] if e["seam"] == "sync"]
+    assert ev["action"] == "recovered"
+    (summary_file,) = jdir.glob("*.json")
+    with open(summary_file) as f:
+        assert json.load(f)["faultEvents"] == rec["faultEvents"]

@@ -379,3 +379,100 @@ def test_trace_report_prefetch_stall_column(tmp_path):
     spec.loader.exec_module(mod)
     out = "\n".join(mod.report(str(tdir)))
     assert "pf-stall ms" in out, out
+
+
+# ---------------------------------------------------------------------------
+# edge faults: final-chunk worker failure, shutdown race, injected recovery
+# ---------------------------------------------------------------------------
+
+
+def test_ring_worker_exception_on_final_chunk_upload():
+    """A worker exception during the FINAL chunk's prepare (the upload
+    step) must re-raise at the driver's last fetch — after every earlier
+    chunk delivered — and the finally/close teardown must join the
+    worker: no thread leak, no hang, no half-delivered stream."""
+    import threading
+    n = 6
+
+    def prepare(x):
+        if x == n - 1:
+            raise ValueError("upload failed on final chunk")
+        return x
+
+    before = threading.active_count()
+    ring = PF.ChunkRing(iter(range(n)), prepare=prepare, depth=2)
+    got = []
+    try:
+        with pytest.raises(ValueError, match="final chunk"):
+            while True:
+                item = ring.next_chunk()
+                if item is None:
+                    break
+                got.append(item)
+    finally:
+        ring.close()
+    assert got == list(range(n - 1)), "earlier chunks must deliver"
+    assert not ring._thread.is_alive(), "close() must join the worker"
+    assert ring.next_chunk() is None      # stable after the error
+    deadline = time.monotonic() + 5.0
+    while threading.active_count() > before and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before, "worker thread leaked"
+
+
+def test_ring_shutdown_race_during_inflight_prepare():
+    """close() while the worker is INSIDE prepare (an in-flight
+    device_put): the shutdown must signal, wake any backpressure block,
+    and join once the in-flight step returns — deterministically
+    event-gated, no thread leak, the driver never hangs."""
+    import threading
+    started = threading.Event()
+    release = threading.Event()
+
+    def prepare(x):
+        if x == 0:
+            started.set()
+            assert release.wait(timeout=30.0), "test gate never released"
+        return x
+
+    ring = PF.ChunkRing(iter(range(8)), prepare=prepare, depth=2)
+    assert started.wait(timeout=10.0), "worker never entered prepare"
+    closed = threading.Event()
+
+    def closer():
+        ring.close()
+        closed.set()
+
+    t = threading.Thread(target=closer, daemon=True)
+    t.start()
+    # the close() is blocked on the in-flight prepare; releasing it must
+    # let the join complete promptly
+    release.set()
+    assert closed.wait(timeout=10.0), "close() hung on in-flight prepare"
+    t.join(timeout=5.0)
+    assert not ring._thread.is_alive(), "worker leaked past close()"
+    assert ring.next_chunk() is None
+
+
+def test_ring_transient_fault_recovers_in_order(monkeypatch):
+    """An injected transient prepare fault (NDS_TPU_FAULT=prefetch) must
+    recover through the worker's bounded retry: every item delivers, in
+    order, and the recovery's FaultEvent re-records on the DRIVER
+    thread's ring (worker-side evidence is never lost)."""
+    from nds_tpu.engine import faults as F
+    F.reset_fault_counts()
+    F.drain_fault_events()
+    monkeypatch.setenv("NDS_TPU_FAULT", "prefetch:error:1")
+    ring = PF.ChunkRing(iter(range(5)), prepare=lambda x: x * 10, depth=2)
+    try:
+        got = [ring.next_chunk() for _ in range(5)]
+        assert ring.next_chunk() is None
+    finally:
+        ring.close()
+    monkeypatch.delenv("NDS_TPU_FAULT")
+    assert got == [0, 10, 20, 30, 40], "retry broke delivery order"
+    events = F.drain_fault_events()
+    assert [(e.seam, e.action) for e in events] == \
+        [("prefetch", "recovered")], events
+    F.reset_fault_counts()

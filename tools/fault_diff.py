@@ -1,0 +1,448 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Fault-injection differential: the runtime half of the fault-tolerance
+contract (DESIGN.md "Fault-tolerance contract").
+
+The fault registry (``nds_tpu/engine/faults.py``) is a static MODEL of
+the engine's failure seams and recovery policies; a model nobody injects
+drifts. This harness sweeps the deterministic injection matrix
+(``NDS_TPU_FAULT=seam:kind:nth``) over the canonical
+``tests/test_synccount.py`` A/B templates and fails unless every
+injection lands in exactly one of the two permitted outcomes:
+
+* **recovered, bit-for-bit** — the injected run's rows equal the
+  fault-free baseline exactly (a retry or a degradation-ladder step may
+  change the PATH, never the math), the injection actually FIRED
+  (occurrence counter), and the drained FaultEvents match the
+  injection exactly (one recovery event at the injected seam — the
+  evidence rule the ``swallowed-fault`` lint enforces statically);
+* **classified error, within the deadline** — a
+  :class:`faults.FaultError` (e.g. ``StatementTimeout`` from the
+  statement watchdog, the fatal ``peer`` refusal) raised within the
+  entry's wall bound. Never a hang, never silently wrong rows, never an
+  unclassified exception.
+
+Every registered seam has at least one tier-1 injection: the engine
+seams here, ``bench-child`` in ``tests/test_bench.py`` (it needs the
+driver's subprocess supervisor) — ``tests/test_faults.py`` asserts the
+registry is fully covered by that union, so a NEW seam cannot land
+without its injection.
+
+``--inject-drift`` sets ``NDS_TPU_FAULT_DRIFT`` (recovery suppression:
+``with_retry`` stops retrying, ``record_fault_event`` stops recording)
+and reruns a recovering subset — every entry MUST then fail (rows
+diverge, an unclassified error escapes, or the event count no longer
+matches), proving the harness can detect a dropped recovery path
+(``tests/test_faults.py`` asserts both directions in tier-1).
+"""
+
+import argparse
+import contextlib
+import importlib.util
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# the cheap filter+projection template: one streamed scan, every seam on
+# its path (prefetch ring, device-put, compile, sync) — bounded wall
+_TEMPLATE = 1
+# the partitioned fan-out template the sharded EXCHANGE entry drives
+_TEMPLATE_SHARDED = 7
+
+_AB_MOD = None
+
+
+def _load_ab_module():
+    global _AB_MOD
+    if _AB_MOD is None:
+        path = os.path.join(REPO, "tests", "test_synccount.py")
+        spec = importlib.util.spec_from_file_location(
+            "_synccount_fixtures_faults", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _AB_MOD = mod
+    return _AB_MOD
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    """Set/unset env vars for one arm, always restoring (None = unset)."""
+    old = {k: os.environ.get(k) for k in kv}
+    for k, v in kv.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = str(v)
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _fresh(reset=True):
+    """A fresh toy session over a cold engine (the injected compile must
+    actually run: NDS_TPU_FAULT is deliberately pipeline-cache-EXEMPT,
+    so the harness resets the cache around every injected arm — the
+    reviewed justification in conc_audit.CACHE_REGISTRY)."""
+    import numpy as np
+
+    from nds_tpu.engine import stream
+    mod = _load_ab_module()
+    if reset:
+        stream.reset_pipeline_cache()
+    return mod._chunked_star_session(np.random.default_rng(42))
+
+
+def _clean_faults():
+    from nds_tpu.engine import faults as F
+    F.reset_fault_counts()
+    F.drain_fault_events()
+
+
+class Failure(Exception):
+    pass
+
+
+def _run_template(session, idx):
+    mod = _load_ab_module()
+    q, _must = mod._STREAM_AB_QUERIES[idx]
+    return session.sql(q).collect()
+
+
+def _expect_recovered(name, seam, baseline, rows, wall_s, wall_bound_s,
+                      n_events=1):
+    from nds_tpu.engine import faults as F
+    if wall_s > wall_bound_s:
+        raise Failure(f"{name}: wall {wall_s:.1f}s exceeded the "
+                      f"{wall_bound_s:.0f}s bound (hang mode survived?)")
+    if rows != baseline or not rows:
+        raise Failure(f"{name}: recovered rows diverged from the "
+                      "fault-free baseline (silent wrong rows)")
+    if F.fired_count(seam) < 1:
+        raise Failure(f"{name}: the injection never fired — the check "
+                      "was vacuous")
+    events = F.drain_fault_events()
+    at_seam = [e for e in events if e.seam == seam]
+    if len(at_seam) != n_events:
+        raise Failure(
+            f"{name}: FaultEvent count at seam {seam!r} is "
+            f"{len(at_seam)}, injections were {n_events} "
+            f"(all events: {[(e.seam, e.action) for e in events]}) — "
+            "the recovery path stopped recording (swallowed fault)")
+
+
+# ---------------------------------------------------------------------------
+# matrix entries
+# ---------------------------------------------------------------------------
+
+
+def entry_prefetch(baseline):
+    """Transient worker fault during slice/encode/upload: the ring's
+    bounded retry recovers in place, evidence re-recorded driver-side."""
+    from nds_tpu.engine import faults as F  # noqa: F401
+    s = _fresh()
+    _clean_faults()
+    with _env(NDS_TPU_FAULT="prefetch:error:1"):
+        t0 = time.monotonic()
+        rows = _run_template(s, _TEMPLATE)
+        wall = time.monotonic() - t0
+    _expect_recovered("prefetch", "prefetch", baseline, rows, wall, 60)
+
+
+def entry_device_put(baseline):
+    """Transient upload fault (fires in whichever prepare — inline first
+    chunk or ring worker — reaches occurrence 1): bounded retry."""
+    s = _fresh()
+    _clean_faults()
+    with _env(NDS_TPU_FAULT="device-put:error:1"):
+        t0 = time.monotonic()
+        rows = _run_template(s, _TEMPLATE)
+        wall = time.monotonic() - t0
+    _expect_recovered("device-put", "device-put", baseline, rows, wall, 60)
+
+
+def entry_pipeline_compile(baseline):
+    """Degradable build fault: compiled->eager ladder step, one degrade
+    FaultEvent, rows bit-for-bit."""
+    s = _fresh()
+    _clean_faults()
+    with _env(NDS_TPU_FAULT="pipeline-compile:error:1"):
+        t0 = time.monotonic()
+        rows = _run_template(s, _TEMPLATE)
+        wall = time.monotonic() - t0
+    _expect_recovered("pipeline-compile", "pipeline-compile", baseline,
+                      rows, wall, 60)
+
+
+def entry_sync_retry(baseline):
+    """Transient materializing-sync fault: the idempotent fetch retries
+    (re-charging the same bound — exec_audit's retry-paths row)."""
+    s = _fresh()
+    _clean_faults()
+    with _env(NDS_TPU_FAULT="sync:error:1"):
+        t0 = time.monotonic()
+        rows = _run_template(s, _TEMPLATE)
+        wall = time.monotonic() - t0
+    _expect_recovered("sync-retry", "sync", baseline, rows, wall, 60)
+
+
+def entry_sync_hang_watchdog(_baseline):
+    """The watchdog proof: a hung materializing sync (hang-kind
+    injection, 20 s) under a 2 s statement deadline must raise the
+    classified StatementTimeout well before the hang would have ended —
+    no hang mode survives."""
+    from nds_tpu.engine import faults as F
+    s = _fresh()
+    _clean_faults()
+    with _env(NDS_TPU_FAULT="sync:hang:1", NDS_TPU_FAULT_HANG_S="20",
+              NDS_TPU_STATEMENT_DEADLINE_S="2"):
+        t0 = time.monotonic()
+        try:
+            _run_template(s, _TEMPLATE)
+        except F.StatementTimeout:
+            wall = time.monotonic() - t0
+        except Exception as exc:
+            raise Failure(f"sync-hang: unclassified {type(exc).__name__} "
+                          f"escaped instead of StatementTimeout: {exc}")
+        else:
+            raise Failure("sync-hang: the hung statement completed — "
+                          "the injection never engaged the watchdog")
+    if wall >= 15:
+        raise Failure(f"sync-hang: StatementTimeout took {wall:.1f}s — "
+                      "the watchdog did not beat the hang")
+    events = [e for e in F.drain_fault_events() if e.seam == "sync"]
+    if not any(e.action == "timeout" for e in events):
+        raise Failure("sync-hang: no timeout FaultEvent recorded")
+    _clean_faults()
+
+
+def entry_chunk_store_read(baseline):
+    """Transient store-read fault on a WARM store: delete + re-encode
+    from source, rows bit-for-bit."""
+    with tempfile.TemporaryDirectory() as d:
+        with _env(NDS_TPU_CHUNK_STORE=d):
+            warm = _run_template(_fresh(), _TEMPLATE)   # persist entries
+            if warm != baseline:
+                raise Failure("chunk-store-read: store path diverged "
+                              "before any injection")
+            s = _fresh()
+            _clean_faults()
+            with _env(NDS_TPU_FAULT="chunk-store-read:error:1"):
+                t0 = time.monotonic()
+                rows = _run_template(s, _TEMPLATE)
+                wall = time.monotonic() - t0
+            _expect_recovered("chunk-store-read", "chunk-store-read",
+                              baseline, rows, wall, 60)
+
+
+def entry_chunk_store_write(baseline):
+    """Degradable store-write fault on a COLD store: the best-effort
+    persist degrades to the in-memory wire plan, statement unharmed."""
+    with tempfile.TemporaryDirectory() as d:
+        with _env(NDS_TPU_CHUNK_STORE=d):
+            s = _fresh()
+            _clean_faults()
+            with _env(NDS_TPU_FAULT="chunk-store-write:error:1"):
+                t0 = time.monotonic()
+                rows = _run_template(s, _TEMPLATE)
+                wall = time.monotonic() - t0
+            _expect_recovered("chunk-store-write", "chunk-store-write",
+                              baseline, rows, wall, 60)
+
+
+def entry_exchange():
+    """Degradable collective-dispatch fault on a forced 2-shard mesh:
+    sharded compiled -> single-device eager rerun, bit-for-bit vs the
+    fault-free sharded run. Skipped (None) without a multi-device
+    mesh."""
+    import jax
+    mod = _load_ab_module()
+    if len(jax.local_devices()) < mod._STREAM_AB_SHARD_COUNT:
+        return "skipped: needs a multi-device (virtual) mesh"
+    with mod._forced_stream_shards():
+        base = _run_template(_fresh(), _TEMPLATE_SHARDED)
+        s = _fresh()
+        _clean_faults()
+        with _env(NDS_TPU_FAULT="exchange:error:1"):
+            t0 = time.monotonic()
+            rows = _run_template(s, _TEMPLATE_SHARDED)
+            wall = time.monotonic() - t0
+        _expect_recovered("exchange", "exchange", base, rows, wall, 120)
+    return None
+
+
+def entry_peer():
+    """Fatal federation-peer fault: maybe_initialize raises the
+    classified error promptly (no retry loop, no hang) and records the
+    fatal FaultEvent."""
+    from nds_tpu.engine import faults as F
+    from nds_tpu.parallel import multihost
+    if multihost._initialized:
+        return "skipped: federation already initialized in-process"
+    _clean_faults()
+    with _env(NDS_TPU_MULTIHOST="1", NDS_TPU_FAULT="peer:error:1"):
+        t0 = time.monotonic()
+        try:
+            multihost.maybe_initialize()
+        except F.FaultInjected:
+            wall = time.monotonic() - t0
+        except Exception as exc:
+            raise Failure(f"peer: unclassified {type(exc).__name__} "
+                          f"escaped: {exc}")
+        else:
+            raise Failure("peer: injected attach fault was absorbed — a "
+                          "half-formed federation could run collectives")
+    if wall > 10:
+        raise Failure(f"peer: classified error took {wall:.1f}s")
+    events = [e for e in F.drain_fault_events() if e.seam == "peer"]
+    if [e.action for e in events] != ["fatal"]:
+        raise Failure(f"peer: expected one fatal FaultEvent, got "
+                      f"{[(e.seam, e.action) for e in events]}")
+    _clean_faults()
+    return None
+
+
+def entry_ledger_write():
+    """Transient ledger-write fault: one bounded retry lands the record
+    durably; the campaign never notices."""
+    from nds_tpu.engine import faults as F
+    from nds_tpu.obs import ledger as L
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "campaign.jsonl")
+        led = L.Ledger(path, driver="bench")
+        _clean_faults()
+        with _env(NDS_TPU_FAULT="ledger-write:error:1"):
+            led.query("query1", status="ok", ms=1.0)
+        if led.write_failures:
+            raise Failure("ledger-write: one injected fault must retry "
+                          "clean, not degrade")
+        led.close("completed")
+        data = L.load_ledger(path)
+        if "query1" not in data.queries or data.end is None:
+            raise Failure("ledger-write: retried record/terminal missing")
+        events = [e for e in F.drain_fault_events()
+                  if e.seam == "ledger-write"]
+        if [e.action for e in events] != ["recovered"]:
+            raise Failure(f"ledger-write: expected one recovered "
+                          f"FaultEvent, got "
+                          f"{[(e.seam, e.action) for e in events]}")
+    _clean_faults()
+
+
+# seams whose tier-1 injection lives elsewhere (asserted as a union by
+# tests/test_faults.py's coverage check)
+COVERED_ELSEWHERE = {
+    "bench-child": "tests/test_bench.py::"
+                   "test_bench_child_fault_injection_degrades_to_restart_path",
+}
+
+
+def run_diff(inject_drift=False, verbose=True):
+    """Run the matrix; returns a list of failure strings (empty = pass).
+    ``inject_drift`` reruns a recovering subset with recovery suppressed
+    — every entry must then FAIL."""
+    mod = _load_ab_module()
+    failures = []
+    notes = []
+
+    def log(msg):
+        if verbose:
+            print(f"# fault_diff: {msg}", file=sys.stderr)
+
+    with mod._forced_stream_partitions():
+        if inject_drift:
+            with _env(NDS_TPU_FAULT_DRIFT="1"):
+                baseline = _run_template(_fresh(), _TEMPLATE)
+                for name, fn in (("prefetch", entry_prefetch),
+                                 ("sync-retry", entry_sync_retry)):
+                    try:
+                        fn(baseline)
+                    except Failure as exc:
+                        failures.append(f"drift:{name}: {exc}")
+                    except Exception as exc:
+                        failures.append(
+                            f"drift:{name}: {type(exc).__name__}: {exc}")
+                    finally:
+                        _clean_faults()
+            return failures
+        baseline = _run_template(_fresh(), _TEMPLATE)
+        if not baseline:
+            return ["baseline template returned no rows"]
+        for name, fn in (("prefetch", entry_prefetch),
+                         ("device-put", entry_device_put),
+                         ("pipeline-compile", entry_pipeline_compile),
+                         ("sync-retry", entry_sync_retry),
+                         ("sync-hang-watchdog", entry_sync_hang_watchdog),
+                         ("chunk-store-read", entry_chunk_store_read),
+                         ("chunk-store-write", entry_chunk_store_write)):
+            log(name)
+            try:
+                fn(baseline)
+            except Failure as exc:
+                failures.append(str(exc))
+            except Exception as exc:
+                failures.append(f"{name}: unclassified "
+                                f"{type(exc).__name__}: {exc}")
+            finally:
+                _clean_faults()
+        for name, fn in (("exchange", entry_exchange),
+                         ("peer", entry_peer),
+                         ("ledger-write", entry_ledger_write)):
+            log(name)
+            try:
+                note = fn()
+                if note:
+                    notes.append(f"{name}: {note}")
+            except Failure as exc:
+                failures.append(str(exc))
+            except Exception as exc:
+                failures.append(f"{name}: unclassified "
+                                f"{type(exc).__name__}: {exc}")
+            finally:
+                _clean_faults()
+        # the state the matrix leaves behind must be clean: one final
+        # fault-free run, bit-for-bit vs the opening baseline
+        final = _run_template(_fresh(), _TEMPLATE)
+        if final != baseline:
+            failures.append("post-matrix fault-free rerun diverged — an "
+                            "injection poisoned engine state")
+    for n in notes:
+        log(n)
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fault-injection differential harness")
+    ap.add_argument("--inject-drift", action="store_true",
+                    help="suppress the recovery machinery "
+                    "(NDS_TPU_FAULT_DRIFT) and require the harness to "
+                    "FAIL — the self-test of the gate")
+    args = ap.parse_args(argv)
+    failures = run_diff(inject_drift=args.inject_drift)
+    if args.inject_drift:
+        if failures:
+            print(f"# drift detected as designed ({len(failures)} "
+                  "failures) — the gate can fail", file=sys.stderr)
+            return 0
+        print("# DRIFT NOT DETECTED: recovery suppression passed the "
+              "matrix — the gate is vacuous", file=sys.stderr)
+        return 1
+    for f in failures:
+        print(f"FAULT-DIFF FAILURE: {f}", file=sys.stderr)
+    print(f"# fault_diff: {'FAILED' if failures else 'ok'}",
+          file=sys.stderr)
+    return 2 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -112,9 +112,14 @@ def git_changed_files():
 # polices; nds_tpu/io/chunk_store.py holds the persistent wire format
 # the streamed chunks upload — codec-layout edits there rerun the
 # corpus passes like any other engine-semantics change.
+# nds_tpu/engine/faults.py (explicit for the same reason) holds the
+# fault registry + recovery-policy layer: seam/classification edits
+# move the retry-paths row of exec_audit's sync model and the
+# swallowed-fault rule's contract, so they rerun the corpus passes.
 _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/engine", "nds_tpu/engine/kernels.py",
                  "nds_tpu/engine/prefetch.py",
+                 "nds_tpu/engine/faults.py",
                  "nds_tpu/schema.py",
                  "nds_tpu/listener.py", "nds_tpu/io/columnar.py",
                  "nds_tpu/io/chunk_store.py",
