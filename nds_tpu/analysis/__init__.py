@@ -19,6 +19,13 @@ with no device in the loop:
   / eager-fallback / device-resident) and static host-sync bounds.
 * :mod:`nds_tpu.analysis.mem_audit` — per-statement peak-HBM byte bounds
   and the stream-accumulator proofs ``engine/stream.py`` sizes from.
+* :mod:`nds_tpu.analysis.perf_audit` — the static byte/roofline cost
+  model over the same decomposition: exact h2d upload bytes (the padded
+  encoded-chunk closed form), per-stage HBM traffic, sharded ICI wire
+  bytes from the collective-budget shapes, the fused-kernel launch band,
+  and a roofline lower-bound wall with a ranked bottleneck tag per
+  statement. Exactness is differentially checked against runtime
+  ``StreamEvent`` byte evidence by ``tools/perf_audit_diff.py``.
 * :mod:`nds_tpu.analysis.driver_audit` — driver-level hygiene for the
   top-level CLIs and ``tools/``: swallowed exceptions, shell-injection
   surfaces, file handles opened outside context managers.
@@ -31,7 +38,7 @@ with no device in the loop:
   computation appears in its key). Runtime half:
   ``tools/conc_audit_diff.py``'s threaded stress differential.
 
-``tools/lint.py`` runs all six and gates on new findings against the
+``tools/lint.py`` runs all seven and gates on new findings against the
 checked-in :data:`BASELINE_PATH` (accepted pre-existing findings); code-lint
 findings are suppressible in-source with ``# nds-lint: ignore[rule]``.
 """

@@ -1385,6 +1385,185 @@ def test_mem_audit_sharded_bound_differential():
 
 
 # ---------------------------------------------------------------------------
+# perf auditor: the static byte/roofline cost model
+# ---------------------------------------------------------------------------
+
+
+def _load_perf_diff(name="perf_audit_diff_t"):
+    path = os.path.join(REPO, "tools", "perf_audit_diff.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_audit_corpus_prices_clean():
+    """Every corpus statement prices host-only with zero findings: no
+    compiled scan fell to the unknown-table default width, and every
+    compiled-stream statement carries a nonzero byte/roofline wall."""
+    from nds_tpu.analysis.perf_audit import (audit_perf_corpus,
+                                             reports_to_findings)
+    reports = audit_perf_corpus()
+    assert len(reports) == 103
+    assert reports_to_findings(reports) == []
+    for r in reports:
+        if r.classification in ("compiled-stream", "device-resident"):
+            assert r.roofline_ms > 0, r.query
+            assert r.bytes_hbm > 0, r.query
+        if r.classification == "compiled-stream":
+            assert r.bytes_h2d > 0, r.query
+            assert all(s.priced for s in r.scans if s.compiled), r.query
+
+
+def test_perf_bottleneck_histogram_pinned():
+    """The corpus cost story is a tier-1 contract, pinned like the 96/7
+    classification counts: a width-model or stage-model change that
+    silently shifts which link bounds a statement must fail loudly.
+    Update these counts ONLY together with the matching engine/model
+    change — the lockstep rule."""
+    from nds_tpu.analysis.perf_audit import (audit_perf_corpus,
+                                             bottleneck_counts)
+    counts = bottleneck_counts(audit_perf_corpus())
+    assert counts == {"h2d-bound": 89, "hbm-bound": 14}, counts
+
+
+def test_perf_roofline_knobs_move_walls_not_bytes(monkeypatch):
+    """NDS_TPU_ROOFLINE_*_GBS re-rates the walls (and can flip the
+    bottleneck tag) but NEVER the byte totals — rates are frozen at
+    auditor construction, bytes are pure chunk-shape arithmetic."""
+    from nds_tpu.analysis.mem_audit import MemModel
+    from nds_tpu.analysis.perf_audit import PerfAuditor, roofline_gbs
+    monkeypatch.setenv("NDS_TPU_ROOFLINE_ICI_GBS", "93")
+    assert roofline_gbs()["ici"] == 93.0
+    assert roofline_gbs()["hbm"] == 819.0        # untouched -> default
+    sql = ("select ss_item_sk, count(*) c from store_sales "
+           "group by ss_item_sk")
+
+    def price():
+        model = MemModel(row_bounds={"store_sales": 20_000})
+        return PerfAuditor(streamed={"store_sales"},
+                           model=model).audit_sql(sql)
+
+    base = price()
+    assert base.classification == "compiled-stream"
+    assert base.bound == "h2d-bound"             # 32 GB/s PCIe vs HBM
+    monkeypatch.setenv("NDS_TPU_ROOFLINE_H2D_GBS", "1e9")
+    monkeypatch.setenv("NDS_TPU_ROOFLINE_HBM_GBS", "0.001")
+    rerated = price()
+    assert rerated.bound == "hbm-bound"
+    assert rerated.bytes_h2d == base.bytes_h2d
+    assert rerated.bytes_hbm == base.bytes_hbm
+    assert rerated.wall_hbm_ms > base.wall_hbm_ms
+
+
+def test_perf_audit_differential_harness():
+    """The exactness contract: measured ``StreamEvent.bytes_h2d`` must
+    EQUAL the closed-form prediction on every A/B template (live wire
+    widths + the toy session's real rows/chunk geometry), warm must be
+    byte-identical to cold, and the zeroed-prediction drift fixture must
+    fail."""
+    import numpy as np
+    mod = _load_perf_diff()
+    ab = mod._load_ab_module()
+    queries = ab._STREAM_AB_QUERIES
+    with ab._forced_stream_partitions():
+        session = ab._chunked_star_session(np.random.default_rng(42))
+        bounds, chunk_rows = mod._session_params(session)
+        assert bounds["store_sales"] == 20_000  # the toy session's truth
+        assert chunk_rows == 2048       # passed to ChunkedTable, not env
+        reports = mod.predict(queries, bounds, chunk_rows,
+                              mod._wire_cols(session))
+        evidence = mod._run_sweep(ab, session, list(range(len(queries))))
+    # live wire widths upgrade every prediction from bound to equality
+    assert all(r.h2d_exact for r in reports)
+    # ab12's scalar-subquery chain prices TWO store_sales pipelines,
+    # both at the statement-level pruning (the planner prunes once)
+    assert sum(1 for c in reports[11].scans if c.compiled) == 2
+    ok, lines = mod.compare(reports, evidence)
+    assert ok, "\n".join(lines)
+    drift_ok, drift_lines = mod.compare(reports, evidence, inject=True)
+    assert not drift_ok, "drift fixture failed to fail"
+    assert any("EXACTNESS LOST" in ln for ln in drift_lines)
+
+
+def test_perf_audit_kernel_arm_differential():
+    """Fused-kernel arm: the upload equality holds unchanged (the
+    kernels collapse HBM re-reads, not h2d) and measured launches land
+    inside the nonzero static band; zeroed bands must fail."""
+    import numpy as np
+    mod = _load_perf_diff("perf_audit_diff_t2")
+    ab = mod._load_ab_module()
+    queries = ab._STREAM_AB_QUERIES
+    idxs = list(ab._STREAM_AB_KERNEL)
+    with ab._forced_stream_partitions():
+        with ab._forced_pallas("interpret"):
+            session = ab._chunked_star_session(np.random.default_rng(42))
+            bounds, chunk_rows = mod._session_params(session)
+            reports = mod.predict(queries, bounds, chunk_rows,
+                                  mod._wire_cols(session))
+            evidence = mod._run_sweep(ab, session, idxs)
+    assert any(c.kernel_max > 0 for i in idxs for c in reports[i].scans)
+    ok, lines = mod.compare_kernels(reports, evidence)
+    assert ok, "\n".join(lines)
+    drift_ok, _lines = mod.compare_kernels(reports, evidence, inject=True)
+    assert not drift_ok, "kernel drift fixture failed to fail"
+
+
+def test_perf_audit_sharded_ici_differential():
+    """Sharded arm: measured ``StreamEvent.bytes_ici`` must EQUAL the
+    static exchange+reduce aval arithmetic (every subset template is
+    ici-exact — no outer builds), and zeroed predictions must fail."""
+    import jax
+    import numpy as np
+    mod = _load_perf_diff("perf_audit_diff_t3")
+    ab = mod._load_ab_module()
+    queries = ab._STREAM_AB_QUERIES
+    with ab._forced_stream_partitions():
+        with ab._forced_stream_shards() as n_shards:
+            assert len(jax.local_devices()) >= n_shards, \
+                "sharded arm needs the forced multi-device mesh"
+            session = ab._chunked_star_session(np.random.default_rng(42))
+            bounds, chunk_rows = mod._session_params(session)
+            reports = mod.predict(queries, bounds, chunk_rows,
+                                  mod._wire_cols(session))
+            evidence = mod._run_sweep(ab, session,
+                                      list(ab._STREAM_AB_SHARDED))
+    # the exchange pass is live on at least one subset statement (the
+    # arm would be vacuous if every pipeline were reduce-only)
+    assert any(c.exchange for i in ab._STREAM_AB_SHARDED
+               for c in reports[i].scans)
+    ok, lines = mod.compare_sharded(reports, evidence, n_shards)
+    assert ok, "\n".join(lines)
+    drift_ok, drift_lines = mod.compare_sharded(reports, evidence,
+                                                n_shards, inject=True)
+    assert not drift_ok, "sharded drift fixture failed to fail"
+    assert any("EXACTNESS LOST" in ln for ln in drift_lines)
+
+
+def test_perf_audit_encoded_off_differential():
+    """NDS_TPU_ENCODED=0 arm: the same h2d equality at PLAIN widths —
+    the arm that catches a width table hard-wired to the encoded path.
+    The toy star's int64 columns ride 8+1 wire bytes unencoded."""
+    import numpy as np
+    mod = _load_perf_diff("perf_audit_diff_t4")
+    ab = mod._load_ab_module()
+    queries = ab._STREAM_AB_QUERIES
+    with mod._encoded_off():
+        with ab._forced_stream_partitions():
+            session = ab._chunked_star_session(np.random.default_rng(42))
+            bounds, chunk_rows = mod._session_params(session)
+            wire = mod._wire_cols(session)
+            reports = mod.predict(queries, bounds, chunk_rows, wire)
+            evidence = mod._run_sweep(ab, session,
+                                      list(mod._ENCODED_OFF_SUBSET))
+    assert set(wire["store_sales"].values()) == {9}
+    ok, lines = mod.compare(reports, evidence)
+    assert ok, "\n".join(lines)
+    drift_ok, _lines = mod.compare(reports, evidence, inject=True)
+    assert not drift_ok, "encoded-off drift fixture failed to fail"
+
+
+# ---------------------------------------------------------------------------
 # baseline diffing + CI gate
 # ---------------------------------------------------------------------------
 
@@ -1447,8 +1626,9 @@ def test_lint_cli_format_json(tmp_path):
     doc = json.loads(r.stdout)
     assert doc["version"] == 1
     assert set(doc["pass_counts"]) == {"plan-audit", "exec-audit",
-                                       "mem-audit", "jax-lint",
-                                       "driver-audit", "conc-audit"}
+                                       "mem-audit", "perf-audit",
+                                       "jax-lint", "driver-audit",
+                                       "conc-audit"}
     entries = doc["findings"]
     assert entries == sorted(
         entries, key=lambda e: (e["rule"], e["file"], e["symbol"]))
@@ -1525,6 +1705,29 @@ def test_lint_cli_mem_report():
     doc = json.loads(r.stdout)
     assert len(doc["mem_report"]) >= 99
     assert all(e["peak_bytes"] > 0 for e in doc["mem_report"])
+
+
+def test_lint_cli_perf_report():
+    r = _run_lint("--perf-report")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "per-statement static cost model" in r.stdout
+    assert "rates GB/s" in r.stdout
+    # the pinned histogram rides the summary line
+    assert "h2d-bound" in r.stdout and "hbm-bound" in r.stdout
+    # --format json keeps stdout ONE parseable document with the full
+    # cost table inline — the machine-readable round trip
+    r = _run_lint("--perf-report", "--format", "json")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    entries = doc["perf_report"]
+    assert len(entries) == 103
+    for e in entries:
+        assert e["bound"] in ("h2d-bound", "hbm-bound", "ici-bound",
+                              "sync-bound")
+        if e["classification"] == "compiled-stream":
+            assert e["bytes_h2d"] > 0 and e["roofline_ms"] > 0
+            assert e["scans"] and all(s["priced"] for s in e["scans"]
+                                      if s["compiled"])
 
 
 def test_lint_cli_changed_fast_path():
@@ -2191,15 +2394,16 @@ def test_conc_audit_differential_harness():
 
 
 def test_lint_jobs_thread_pool_matches_sequential():
-    """--jobs N runs the six passes in a thread pool with identical
+    """--jobs N runs the seven passes in a thread pool with identical
     findings/counts — the analysis layer passing its own audit, live."""
     import importlib.util
     path = os.path.join(REPO, "tools", "lint.py")
     spec = importlib.util.spec_from_file_location("lint_tool_j", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    f1, c1, _r1, _m1, _e1 = mod.run_passes(jobs=1)
-    f6, c6, _r6, _m6, _e6 = mod.run_passes(jobs=6)
+    f1, c1, _r1, _m1, _p1, _e1 = mod.run_passes(jobs=1)
+    f6, c6, _r6, _m6, _p6, _e6 = mod.run_passes(jobs=6)
     assert c1 == c6
     assert [str(f) for f in f1] == [str(f) for f in f6]
     assert "conc-audit" in c1
+    assert "perf-audit" in c1

@@ -470,6 +470,97 @@ def test_ab_audit_inject_drift_must_fail(bench_compare, ab_ledger):
     assert any("MISMATCH" in ln for ln in lines)
 
 
+def test_ab_ledger_byte_evidence_matches_cost_model(bench_compare,
+                                                    ab_ledger):
+    """--audit-perf: the recorded ``bytesH2d`` per compiled scan must
+    EQUAL the static cost-model prediction (nds_tpu/analysis/perf_audit)
+    rebuilt from the ledger's own rowBounds meta, and the sharded
+    records' ``bytesIci`` must equal the exchange+reduce arithmetic —
+    the campaign ledger lands pre-wired to its static denominator."""
+    ok, lines = bench_compare.audit_perf(ab_ledger)
+    assert ok, "\n".join(lines)
+    ab1 = [ln for ln in lines if ln.startswith("ok [ab1]")]
+    assert ab1 and "== static" in ab1[0] and "roofline" in ab1[0]
+    # every template in the mini-sweep got a verdict line
+    assert sum(1 for ln in lines if ln.startswith("ok [")) == 14
+
+
+def test_ab_perf_audit_inject_drift_must_fail(bench_compare, ab_ledger):
+    ok, lines = bench_compare.audit_perf(ab_ledger, inject=True)
+    assert not ok, "zeroed byte predictions must be rejected"
+    assert any("EXACTNESS LOST" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# evidence schema round-trip: every event field reaches the ledger
+# ---------------------------------------------------------------------------
+
+
+def test_stream_and_fault_event_fields_all_ledgered(bench_compare,
+                                                    tmp_path):
+    """Every StreamEvent / FaultEvent dataclass field must be carried by
+    its ONE JSON shape (stream_event_json / fault_event_json) and
+    survive ledger write -> load -> bench_compare aggregate. Asserted as
+    FIELD-SET equality against an explicit field->key map, so adding an
+    event field without wiring it through the evidence path (or wiring a
+    key without a field) fails here by construction."""
+    import dataclasses
+
+    from nds_tpu.engine.faults import FaultEvent, fault_event_json
+    from nds_tpu.listener import StreamEvent, stream_event_json
+
+    STREAM_FIELD_TO_KEY = {
+        "where": "table", "chunks": "chunks", "syncs": "syncs",
+        "path": "path", "reason": "reason", "rows": "rows",
+        "partitions": "partitions", "part_rows": "partRows",
+        "bytes_h2d": "bytesH2d", "shards": "shards",
+        "collectives": "collectives", "bytes_ici": "bytesIci",
+        "shard_rows": "shardRows", "kernel_launches": "kernelLaunches",
+        "kernel_fused_stages": "kernelStages",
+        "prefetch_stall_ms": "prefetchStallMs",
+    }
+    fields = {f.name for f in dataclasses.fields(StreamEvent)}
+    assert set(STREAM_FIELD_TO_KEY) == fields, \
+        "new StreamEvent field: add it to stream_event_json AND this map"
+    # every optional field set to an EMITTING value -> every key present
+    ev = StreamEvent(where="store_sales", chunks=4, syncs=1,
+                     path="compiled", reason="note", rows=50,
+                     partitions=2, part_rows=(30, 20), bytes_h2d=100,
+                     shards=2, collectives=7, bytes_ici=64,
+                     shard_rows=(28, 22), kernel_launches=3,
+                     kernel_fused_stages=2, prefetch_stall_ms=1.25)
+    j = stream_event_json(ev)
+    assert set(j) == set(STREAM_FIELD_TO_KEY.values())
+    assert j["table"] == "store_sales" and j["bytesH2d"] == 100
+    assert j["partRows"] == [30, 20] and j["shardRows"] == [28, 22]
+
+    FAULT_FIELD_TO_KEY = {"seam": "seam", "action": "action",
+                          "attempt": "attempt", "detail": "detail"}
+    ffields = {f.name for f in dataclasses.fields(FaultEvent)}
+    assert set(FAULT_FIELD_TO_KEY) == ffields, \
+        "new FaultEvent field: add it to fault_event_json AND this map"
+    fj = fault_event_json(FaultEvent(seam="h2d-upload", action="recovered",
+                                     attempt=2, detail="boom"))
+    assert set(fj) == set(FAULT_FIELD_TO_KEY.values())
+
+    # the durable round trip: write -> load verbatim -> aggregate
+    p = str(tmp_path / "rt.jsonl")
+    led = L.Ledger(p, driver="test", platform="cpu")
+    led.query("q1", status="ok", ms=5.0, hostSyncs=1,
+              streamedScans=[j], faultEvents=[fj])
+    led.close("completed", queries=1)
+    rec = L.load_ledger(p).queries["q1"]
+    assert rec["streamedScans"][0] == j     # verbatim through the ledger
+    assert rec["faultEvents"][0] == fj
+    evd = bench_compare.load_round(p)["evidence"]["q1"]
+    for key, want in [("bytesH2d", 100), ("bytesIci", 64),
+                      ("collectives", 7), ("chunks", 4), ("syncs", 1),
+                      ("partitions", 2), ("shards", 2),
+                      ("prefetchStallMs", 1.25), ("compiled", 1),
+                      ("eager", 0), ("scans", 1), ("hostSyncs", 1)]:
+        assert evd.get(key) == want, (key, evd)
+
+
 def test_ab_ledger_feeds_trace_report_and_sync_profile(ab_ledger,
                                                        tmp_path, capsys):
     """Post-hoc analysis on a completed round: both tools accept the

@@ -455,3 +455,70 @@ def test_trace_report_kernel_arm_delta(tmp_path):
     assert delta and "fused 40.0 ms (10 launches) vs xla 50.0 ms" \
         in delta[0]
     assert "+10.0 ms (+20.0%)" in delta[0]
+
+
+def test_trace_report_ledger_parity_on_byte_columns(tmp_path):
+    """The byte/roofline/pf-stall/static-cost columns must render
+    IDENTICALLY from a trace dir and from the equivalent campaign
+    ledger (including a legacy ledger record that predates the derived
+    ``evidence`` field — the aggregate is re-derived from its
+    ``streamedScans``). Post-hoc analysis on a completed round must not
+    read differently from live traces."""
+    import json
+    spec = importlib.util.spec_from_file_location(
+        "trace_report_p", os.path.join(REPO, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    # one measured run of the corpus template "query3" (a name the
+    # static cost model prices, so the static columns engage): 120 ms
+    # wall, 20 ms of it the collective/materialize phase
+    scan = {"table": "store_sales", "chunks": 4, "syncs": 0,
+            "path": "compiled", "bytesH2d": 4_000_000, "shards": 2,
+            "shardRows": [10, 10], "collectives": 5,
+            "bytesIci": 1_000_000, "prefetchStallMs": 2.5}
+    tdir = tmp_path / "traces"
+    tdir.mkdir()
+    events = [
+        {"ph": "X", "name": "stream", "ts": 0, "dur": 120_000,
+         "args": {"path": "compiled", "bytesH2d": scan["bytesH2d"],
+                  "bytesLogical": scan["bytesH2d"],
+                  "bytesIci": scan["bytesIci"],
+                  "prefetchStallMs": scan["prefetchStallMs"]}},
+        {"ph": "X", "name": "stream.materialize", "ts": 100_000,
+         "dur": 20_000, "args": {}},
+    ]
+    (tdir / "query3.trace.json").write_text(json.dumps(
+        {"traceEvents": events, "nds": {"query": "query3"}}))
+
+    # the equivalent ledger record, legacy-shaped: NO derived
+    # ``evidence`` field, only the per-scan streamedScans evidence
+    led = tmp_path / "round.jsonl"
+    led.write_text(json.dumps(
+        {"v": 1, "kind": "query", "t": 1.0, "name": "query3",
+         "status": "ok", "ms": 120.0, "hostSyncs": 0,
+         "tracePhases": {"phases": {
+             "stream": {"ms": 120.0},
+             "stream.materialize": {"ms": 20.0}}},
+         "streamedScans": [scan]}) + "\n")
+
+    def row(lines):
+        hits = [ln for ln in lines if ln.startswith("| query3 |")]
+        assert len(hits) == 1, "\n".join(lines)
+        return [c.strip() for c in hits[0].strip("|").split("|")]
+
+    t_lines = mod.render(mod.collect_from_traces(str(tdir)), "t")
+    l_lines = mod.render(mod.collect_from_ledger(str(led)), "l")
+    t_row, l_row = row(t_lines), row(l_lines)
+    # both renders carry the static cost-model columns in the header
+    assert any("static-roofline %" in ln for ln in t_lines)
+    assert any("static-roofline %" in ln for ln in l_lines)
+    # same wall, and the 10 tail cells — logical MB, h2d MB, eff GB/s,
+    # %HBM roof, ici MB, ici GB/s, %ICI roof, pf-stall ms,
+    # static-roofline %, unexplained ms — byte-identical across inputs
+    assert t_row[1] == l_row[1] == "120.0"
+    assert t_row[-10:] == l_row[-10:], (t_row, l_row)
+    assert t_row[-10] == "4.0"          # logical MB from bytesH2d
+    assert t_row[-3] == "2.5"           # pf-stall ms
+    # static columns engaged (a priced corpus name, not "-")
+    assert t_row[-2] != "-" and t_row[-1] != "-"

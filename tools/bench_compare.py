@@ -30,7 +30,13 @@ comparison ENFORCEABLE:
   that ledger's recorded syncs/rows/bytes/collectives against the
   exec_audit and mem_audit predictions — the differential-harness
   contract, applied to the DURABLE artifact instead of a live process
-  (so any completed campaign's evidence can be re-audited post hoc).
+  (so any completed campaign's evidence can be re-audited post hoc);
+* **--audit-perf**: re-check the same recorded ledger against the
+  static COST model (nds_tpu/analysis/perf_audit.py): recorded per-scan
+  ``bytesH2d`` must EQUAL the padded-chunk closed form at the live wire
+  widths, and the sharded records' ``bytesIci`` must match the
+  exchange+reduce collective arithmetic — so a completed campaign's
+  byte evidence carries its static denominator, not just its bounds.
 
 Round inputs: a campaign ledger JSONL (nds_tpu/obs/ledger.py — bench.py
 resume files and power.py --ledger files alike, legacy pre-ledger
@@ -44,6 +50,7 @@ Usage:
     python tools/bench_compare.py B.jsonl --emit-perf PERF.md
     python tools/bench_compare.py --record-ab ab.jsonl       # CPU mini-sweep
     python tools/bench_compare.py --audit-ab ab.jsonl [--inject-drift]
+    python tools/bench_compare.py --audit-perf ab.jsonl [--inject-drift]
 """
 
 import argparse
@@ -513,6 +520,116 @@ def audit_ab(path, inject=False):
     return ok, lines
 
 
+def audit_perf(path, inject=False):
+    """Cross-validate a recorded A/B ledger against the static COST
+    model: recorded per-scan ``bytesH2d`` (warm sight — but the closed
+    form is sight-invariant) must EQUAL the perf_audit prediction built
+    from the ledger's own ``rowBounds`` meta plus the toy session's live
+    wire widths, per statement as a sorted multiset; the sharded
+    records' ``bytesIci`` must equal the exchange+reduce arithmetic for
+    ici-exact scans and dominate it otherwise. ``inject`` zeroes every
+    prediction first — the self-test that MUST fail. Returns
+    (ok, lines)."""
+    import numpy as np
+
+    from nds_tpu.obs.ledger import load_ledger
+
+    data = load_ledger(path)
+    mod = _load_ab_module()
+    queries = mod._STREAM_AB_QUERIES
+    row_bounds = {str(k): int(v) for k, v in
+                  (data.meta.get("rowBounds") or {}).items()}
+    with mod._forced_stream_partitions():
+        from nds_tpu.analysis.mem_audit import MemModel
+        from nds_tpu.analysis.perf_audit import (PerfAuditor,
+                                                 wire_column_widths)
+        # the chunk geometry and wire widths are STRUCTURE, not
+        # measurements: rebuild the deterministic toy session to read
+        # them (the row counts stay the ledger's own meta record)
+        session = mod._chunked_star_session(np.random.default_rng(42))
+        store = session.catalog["store_sales"]
+        wire = {"store_sales": wire_column_widths(store)}
+        chunk_rows = getattr(store, "chunk_rows", None)
+
+        def build_reports():
+            model = MemModel(row_bounds=row_bounds, chunk_rows=chunk_rows)
+            auditor = PerfAuditor(streamed={"store_sales"}, model=model,
+                                  wire_cols=wire)
+            return [auditor.audit_sql(sql, query=f"ab{i + 1}")
+                    for i, (sql, _m) in enumerate(queries)]
+
+        reports = build_reports()
+        with mod._forced_stream_shards():
+            sharded_reports = build_reports()
+    ok = True
+    lines = []
+    for i, (sql, _must) in enumerate(queries):
+        name = f"ab{i + 1}"
+        rec = data.queries.get(name)
+        rep = reports[i]
+        problems = []
+        if rec is None:
+            ok = False
+            lines.append(f"MISMATCH [{name}] no ledger record")
+            continue
+        preds = sorted((c.bytes_h2d for c in rep.scans if c.compiled),
+                       reverse=True)
+        if inject:
+            preds = [0 for _ in preds]
+        got = sorted((s["bytesH2d"] for s in rec.get("streamedScans") or []
+                      if s.get("path") == "compiled"
+                      and s.get("bytesH2d", -1) >= 0), reverse=True)
+        if not inject and len(got) != len(preds):
+            problems.append(
+                f"ledger carries {len(got)} compiled byte records, the "
+                f"cost model priced {len(preds)} scans (model drift)")
+        else:
+            for p, g in zip(preds, got):
+                if rep.h2d_exact and g != p:
+                    problems.append(
+                        f"recorded upload {g} bytes != static prediction "
+                        f"{p} (EXACTNESS LOST)")
+                elif not rep.h2d_exact and not inject \
+                        and not (rep.bytes_h2d_min <= g <= p):
+                    problems.append(
+                        f"recorded upload {g} bytes outside static band")
+        srec = data.queries.get(f"{name}@sharded")
+        if srec is not None:
+            srep = sharded_reports[i]
+            ici_preds = sorted(((c.bytes_ici, c.ici_exact)
+                                for c in srep.scans
+                                if c.compiled and c.shards > 1),
+                               reverse=True)
+            if inject:
+                ici_preds = [(0, True) for _ in ici_preds]
+            got_ici = sorted(
+                (s["bytesIci"] for s in srec.get("streamedScans") or []
+                 if s.get("bytesIci", -1) >= 0), reverse=True)
+            if not inject and len(got_ici) != len(ici_preds):
+                problems.append(
+                    f"sharded record carries {len(got_ici)} ICI byte "
+                    f"records, the cost model priced {len(ici_preds)} "
+                    "sharded scans (model drift)")
+            else:
+                for (p, exact), g in zip(ici_preds, got_ici):
+                    if exact and g != p:
+                        problems.append(
+                            f"recorded ICI {g} bytes != static "
+                            f"prediction {p} (EXACTNESS LOST)")
+                    elif not exact and g < p:
+                        problems.append(
+                            f"recorded ICI {g} bytes < static lower "
+                            f"bound {p}")
+        if problems:
+            ok = False
+            lines.append(f"MISMATCH [{name}]")
+            lines.extend(f"    {p}" for p in problems)
+        else:
+            lines.append(f"ok [{name}] recorded h2d {got} == static, "
+                         f"roofline {rep.roofline_ms:.2f} ms ({rep.bound})")
+    return ok, lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="diff two campaign evidence ledgers / bench rounds; "
@@ -544,6 +661,10 @@ def main(argv=None) -> int:
     ap.add_argument("--audit-ab", metavar="PATH",
                     help="cross-validate a recorded A/B ledger against "
                     "exec_audit/mem_audit predictions")
+    ap.add_argument("--audit-perf", metavar="PATH",
+                    help="cross-validate a recorded A/B ledger's byte "
+                    "evidence against the perf_audit static cost model "
+                    "(h2d equality, ICI exchange+reduce arithmetic)")
     args = ap.parse_args(argv)
 
     if args.record_ab:
@@ -568,6 +689,27 @@ def main(argv=None) -> int:
             return 0
         print("# evidence check FAILED: ledger evidence exceeds a "
               "static audit bound (model drift or engine regression)")
+        return 1
+
+    if args.audit_perf:
+        ok, lines = audit_perf(args.audit_perf, inject=args.inject_drift)
+        for ln in lines:
+            print(ln)
+        if args.inject_drift:
+            if ok:
+                print("# DRIFT FIXTURE FAILED TO FAIL: the cost-model "
+                      "check cannot catch a drifted model")
+                return 1
+            print("# drift fixture correctly rejected (cost-model check "
+                  "is live)")
+            return 0
+        if ok:
+            print("# ledger byte evidence matches the perf_audit static "
+                  "cost model")
+            return 0
+        print("# cost-model check FAILED: ledger byte evidence differs "
+              "from the static predictions (model drift or engine "
+              "regression)")
         return 1
 
     if args.emit_perf:

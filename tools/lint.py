@@ -1,7 +1,7 @@
 # Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
-"""Static analysis gate: plan/exec/mem/conc auditors + engine/driver lint.
+"""Static analysis gate: plan/exec/mem/conc/perf auditors + engine/driver lint.
 
-Runs the six :mod:`nds_tpu.analysis` passes entirely on host (no device,
+Runs the seven :mod:`nds_tpu.analysis` passes entirely on host (no device,
 no data) and exits nonzero when any finding is NOT covered by the
 checked-in baseline (``nds_tpu/analysis/baseline.json``) — the accepted
 pre-existing findings. New code must come in clean; accepting a new
@@ -17,6 +17,8 @@ Usage:
                                               # classification (exec-audit)
     python tools/lint.py --mem-report         # per-statement peak-HBM byte
                                               # bounds (mem-audit)
+    python tools/lint.py --perf-report        # per-statement byte totals +
+                                              # roofline walls (perf-audit)
     python tools/lint.py --changed            # lint only files in the
                                               # current git diff
     python tools/lint.py --jobs 6             # run the passes in a thread
@@ -56,6 +58,10 @@ from nds_tpu.analysis.mem_audit import (audit_mem_corpus,  # noqa: E402
                                         format_mem_report)
 from nds_tpu.analysis.mem_audit import \
     reports_to_findings as mem_reports_to_findings  # noqa: E402
+from nds_tpu.analysis.perf_audit import (audit_perf_corpus,  # noqa: E402
+                                         format_perf_report)
+from nds_tpu.analysis.perf_audit import \
+    reports_to_findings as perf_reports_to_findings  # noqa: E402
 from nds_tpu.analysis.plan_audit import audit_corpus  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -116,7 +122,12 @@ def git_changed_files():
 # fault registry + recovery-policy layer: seam/classification edits
 # move the retry-paths row of exec_audit's sync model and the
 # swallowed-fault rule's contract, so they rerun the corpus passes.
+# nds_tpu/analysis/perf_audit.py (explicit for the same reason) is the
+# static cost model whose byte predictions tools/perf_audit_diff.py
+# holds byte-exact against StreamEvent evidence — cost-model edits
+# rerun the corpus passes so the bottleneck histogram pin stays honest.
 _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
+                 "nds_tpu/analysis/perf_audit.py",
                  "nds_tpu/engine", "nds_tpu/engine/kernels.py",
                  "nds_tpu/engine/prefetch.py",
                  "nds_tpu/engine/faults.py",
@@ -136,12 +147,13 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
     (templates, sources) and appends only to its own lists, the exact
     discipline the conc-audit pass itself enforces — findings stay in
     the fixed pass order either way. Returns (findings, pass counts,
-    exec reports, mem reports, elapsed seconds)."""
+    exec reports, mem reports, perf reports, elapsed seconds)."""
     t0 = time.time()
     findings = []
     counts = {}
     reports = []
     mem_reports = []
+    perf_reports = []
     corpus_affected = (
         changed is None or template_dir is not None or want_reports
         or any(c.startswith(_CORPUS_ROOTS) for c in changed))
@@ -153,6 +165,10 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
     def run_mem():
         mem_reports.extend(audit_mem_corpus(template_dir))
         return mem_reports_to_findings(mem_reports)
+
+    def run_perf():
+        perf_reports.extend(audit_perf_corpus(template_dir))
+        return perf_reports_to_findings(perf_reports)
 
     def run_jax():
         if changed is None:
@@ -180,6 +196,7 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
         passes.append(("plan-audit", lambda: audit_corpus(template_dir)))
         passes.append(("exec-audit", run_exec))
         passes.append(("mem-audit", run_mem))
+        passes.append(("perf-audit", run_perf))
     passes.append(("jax-lint", run_jax))
     passes.append(("driver-audit", run_drivers))
     # the concurrency audit is a whole-package pass: any nds_tpu edit
@@ -197,7 +214,8 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
     for name, got in results:
         counts[name] = len(got)
         findings.extend(got)
-    return findings, counts, reports, mem_reports, time.time() - t0
+    return (findings, counts, reports, mem_reports, perf_reports,
+            time.time() - t0)
 
 
 def _aggregate(findings, new):
@@ -238,6 +256,9 @@ def main(argv=None) -> int:
     ap.add_argument("--mem-report", action="store_true",
                     help="print the mem-audit per-statement peak-HBM "
                     "byte bounds and stream-accumulator proofs")
+    ap.add_argument("--perf-report", action="store_true",
+                    help="print the perf-audit per-statement byte totals, "
+                    "roofline walls and static bottleneck tags")
     ap.add_argument("--changed", action="store_true",
                     help="fast path: lint only files in the current git "
                     "diff (full run when not in a git checkout)")
@@ -262,10 +283,12 @@ def main(argv=None) -> int:
 
     changed = git_changed_files() if args.changed else None
 
-    findings, counts, reports, mem_reports, elapsed = run_passes(
-        args.templates, changed=changed,
-        want_reports=args.stream_report or args.mem_report,
-        jobs=max(args.jobs, 1))
+    findings, counts, reports, mem_reports, perf_reports, elapsed = \
+        run_passes(
+            args.templates, changed=changed,
+            want_reports=(args.stream_report or args.mem_report
+                          or args.perf_report),
+            jobs=max(args.jobs, 1))
 
     # diff against the PRE-update baseline so a --json report written
     # alongside --update-baseline shows what was just accepted
@@ -284,6 +307,8 @@ def main(argv=None) -> int:
             doc["stream_report"] = [r.to_dict() for r in reports]
         if mem_reports:
             doc["mem_report"] = [r.to_dict() for r in mem_reports]
+        if perf_reports:
+            doc["perf_report"] = [r.to_dict() for r in perf_reports]
         with open(args.json, "w") as f:
             json.dump(doc, f, indent=1)
 
@@ -297,11 +322,14 @@ def main(argv=None) -> int:
 
     # under --format json stdout must stay a single parseable JSON
     # document: the human tables move to stderr and the classifications
-    # ride in the document's "stream_report"/"mem_report" fields instead
+    # ride in the document's "stream_report"/"mem_report"/"perf_report"
+    # fields instead
     if args.stream_report and reports:
         print(format_stream_report(reports), file=out)
     if args.mem_report and mem_reports:
         print(format_mem_report(mem_reports), file=out)
+    if args.perf_report and perf_reports:
+        print(format_perf_report(perf_reports), file=out)
     for f in new:
         print(f"NEW {f}", file=out)
     n_err = sum(1 for f in new if f.severity == "error")
@@ -317,6 +345,8 @@ def main(argv=None) -> int:
             doc["stream_report"] = [r.to_dict() for r in reports]
         if args.mem_report and mem_reports:
             doc["mem_report"] = [r.to_dict() for r in mem_reports]
+        if args.perf_report and perf_reports:
+            doc["perf_report"] = [r.to_dict() for r in perf_reports]
         print(json.dumps(doc, indent=1))
     if new:
         print("# gate FAILED: fix the findings above, suppress with "

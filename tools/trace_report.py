@@ -27,7 +27,14 @@ Reads every ``*.trace.json`` a driver wrote (``nds_power.py --trace-dir``
    of ``NDS_TPU_ROOFLINE_HBM_GBS`` and its ICI GB/s as a percentage of
    ``NDS_TPU_ROOFLINE_ICI_GBS`` (defaults are v5e-class: 819 / 186;
    set them for the attached part) — so "is the scan fast?" reads off
-   the table instead of requiring the chip datasheet;
+   the table instead of requiring the chip datasheet — plus, for
+   queries the STATIC cost model prices (the corpus templates, via
+   ``nds_tpu/analysis/perf_audit.py``), a ``static-roofline %`` /
+   ``unexplained ms`` pair: the statically-predicted lower-bound wall
+   (max of h2d/HBM/ICI byte totals over the same
+   ``NDS_TPU_ROOFLINE_*_GBS`` knobs, ``_H2D_GBS`` included) as a
+   fraction of the measured wall, and the remainder — measured minus
+   explained — which is the named-overhead worklist;
 5. a ranked NEXT-BOTTLENECK summary — host-sync blocking, eager
    fallbacks, compile time, HBM-roofline headroom and ICI-roofline
    headroom, each priced in attributable milliseconds across the run —
@@ -273,7 +280,14 @@ def collect_from_ledger(path):
         # stream/replay compile span (e.g. eager table-at-a-time ops)
         row["compile_ms"] = rec.get("compileMs",
                                     rec.get("compileS", 0.0) * 1e3)
-        ev = rec.get("evidence") or {}
+        ev = rec.get("evidence")
+        if ev is None and "streamedScans" in rec:
+            # legacy record (pre-evidence field): derive the aggregate
+            # from the per-scan evidence, exactly as the ledger writer
+            # now does — the byte/roofline/pf-stall columns must render
+            # from a ledger identically to the equivalent trace dir
+            ev = ledger_mod().evidence_from_scans(rec["streamedScans"])
+        ev = ev or {}
         row["h2d"] = max(ev.get("bytesH2d", 0), 0)
         row["logical"] = row["h2d"]
         row["ici"] = max(ev.get("bytesIci", 0), 0)
@@ -292,6 +306,25 @@ def collect_from_ledger(path):
             fb["syncs"] += fb_rec.get("syncs", 0)
         per_query[name] = row
     return agg if per_query else None
+
+
+def _static_walls(per_query):
+    """``query -> (roofline_ms, bound)`` from the static cost model
+    (``nds_tpu/analysis/perf_audit.py``) for the queries this run
+    measured — the denominator of the ``static-roofline %`` /
+    ``unexplained ms`` columns. Walls use the SAME
+    ``NDS_TPU_ROOFLINE_*_GBS`` knobs as the measured roofline columns.
+    Returns {} when the model cannot load (no nds_tpu/jax available) or
+    no measured query matches a priced corpus statement — the measured
+    columns render regardless."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO)
+    try:
+        from nds_tpu.analysis.perf_audit import corpus_walls
+        walls = corpus_walls()
+    except Exception:
+        return {}
+    return {q: walls[q] for q in per_query if q in walls}
 
 
 def bottlenecks(agg):
@@ -363,16 +396,22 @@ def render(agg, source, top=10):
     # collectors clamp unknown/-1 to absent)
     any_stall = any(r.get("pf_stall", 0.0) > 0.0
                     for r in per_query.values())
+    # static cost-model columns: only for queries the corpus pricing
+    # covers (same knobs as the measured roofline columns)
+    walls = _static_walls(per_query)
     byte_heads = (" logical MB | h2d MB | eff GB/s | %HBM roof |"
                   if any_bytes else "")
     ici_heads = " ici MB | ici GB/s | %ICI roof |" if any_ici else ""
     stall_heads = " pf-stall ms |" if any_stall else ""
+    static_heads = " static-roofline % | unexplained ms |" if walls else ""
     n_cols = (len(used) + 3 + (4 if any_bytes else 0)
-              + (3 if any_ici else 0) + (1 if any_stall else 0))
+              + (3 if any_ici else 0) + (1 if any_stall else 0)
+              + (2 if walls else 0))
     lines = [f"# trace report: {len(per_query)} queries from {source}",
              "",
              "| query | total ms | " + " | ".join(used) +
-             " | host syncs |" + byte_heads + ici_heads + stall_heads,
+             " | host syncs |" + byte_heads + ici_heads + stall_heads
+             + static_heads,
              "|---" * n_cols + "|"]
     for q in sorted(per_query):
         r = per_query[q]
@@ -397,6 +436,18 @@ def render(agg, source, top=10):
                      f"{igbs / ROOFLINE_ICI_GBS * 100:.1f} |")
         if any_stall:
             tail += f" {r.get('pf_stall', 0.0):.1f} |"
+        if walls:
+            # static-roofline %: how much of the measured wall the
+            # byte-movement lower bound explains; unexplained ms is the
+            # remainder — the named-overhead worklist (a negative
+            # remainder would mean the "lower bound" isn't one: clamped
+            # to zero, and the % then reads > 100 as the tell)
+            w = walls.get(q)
+            if w is not None and r["total_ms"] > 0:
+                tail += (f" {w[0] / r['total_ms'] * 100:.1f} | "
+                         f"{max(r['total_ms'] - w[0], 0.0):.1f} |")
+            else:
+                tail += " - | - |"
         lines.append(f"| {q} | {r['total_ms']:.1f} | {cells} | "
                      f"{r['syncs']} |" + tail)
     comp = sum(r["phases"].get("stream.compile", 0.0)
