@@ -91,6 +91,14 @@ def faults_mod():
     return ledger_mod()._faults_mod()
 
 
+def campaign_mod():
+    """The campaign module (nds_tpu/obs/campaign.py, stdlib-only), by
+    file path: the arm/env-fingerprint stamp every ledger record
+    carries, and the resume-fingerprint refusal."""
+    from tools._ledger_load import campaign_mod as _cm
+    return _cm()
+
+
 def restart_backoff_s(restart_n: int) -> float:
     """Deterministic-JITTERED backoff before child restart ``restart_n``
     (2nd start onwards): exponential base (NDS_BENCH_RESTART_BACKOFF_S,
@@ -666,6 +674,12 @@ def load_resume(path, times, perf):
     if not path or not os.path.exists(path):
         return None
     data = ledger_mod().load_ledger(path)
+    # mixed-arm refusal: a ledger stamped under different knobs must not
+    # be resumed — the merged artifact would silently blend two
+    # experiments (CampaignResumeError names both fingerprints)
+    C = campaign_mod()
+    C.check_resume_fingerprint(data.meta.get("envFingerprint"),
+                               C.env_fingerprint(), path)
     if data.torn:
         print("# resume ledger: torn final line (in-flight statement of "
               "a kill) dropped", file=sys.stderr)
@@ -688,8 +702,12 @@ def run_parent(t_entry):
     resume_platform = load_resume(resume_path, times, perf)
     ledger = None
     if resume_path:
+        # the stamp rides EVERY record (arm name + env fingerprint):
+        # cross-arm merges key on recorded provenance, and load_resume's
+        # fingerprint refusal has something to check on the next rerun
         ledger = ledger_mod().Ledger(resume_path, driver="bench",
-                                     scale=SCALE)
+                                     scale=SCALE,
+                                     stamp=campaign_mod().campaign_stamp())
     # defined BEFORE the handlers register: a kill during data
     # generation must find every name the handler reads
     platform = resume_platform or "unknown"

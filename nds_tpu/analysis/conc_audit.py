@@ -147,6 +147,14 @@ ENTRY_POINTS = (
     ("obs/trace.py", "attach"),
     ("obs/ledger.py", "beat"),               # bench heartbeat thread
     ("obs/ledger.py", "_loop"),
+    # the campaign driver: single-threaded BY CONTRACT — all run state
+    # (manifest dict, in-flight child handle) is local to run_campaign,
+    # module level holds only import-time constants (PRESETS, knob
+    # tuple), and the only cross-thread surface it touches is the fault
+    # registry's thread-local ring — so the whole-module inventory stays
+    # at zero findings; auditing it whole pins that contract against a
+    # future "parallel arms" edit quietly adding shared state.
+    ("obs/campaign.py", ""),
     ("parallel/admission.py", ""),           # admission runs per stream
     ("parallel/exchange.py", "stream_mesh"),
     ("parallel/exchange.py", "exchange_join_pairs"),

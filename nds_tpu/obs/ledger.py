@@ -297,8 +297,13 @@ class Ledger:
     postmortem demanded. Thread-safe: the heartbeat thread interleaves
     ``progress`` records with the main thread's ``query`` records."""
 
-    def __init__(self, path: str, **meta):
+    def __init__(self, path: str, stamp: dict | None = None, **meta):
         self.path = path
+        # provenance stamp merged into EVERY record (campaign arm name,
+        # env-knob fingerprint): cross-arm merges key on what the record
+        # SAYS it measured, not on which file it sat in. Set before the
+        # meta write below so the stamp rides that record too.
+        self._stamp = dict(stamp or {})
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
@@ -341,6 +346,7 @@ class Ledger:
         loader's torn-line tolerance absorbs any partial line a failed
         attempt left."""
         rec = {"v": LEDGER_VERSION, "kind": kind, "t": round(time.time(), 3)}
+        rec.update(self._stamp)
         rec.update(fields)
         _validate(rec, 0)
         line = json.dumps(rec, sort_keys=True)

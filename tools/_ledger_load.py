@@ -16,15 +16,26 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NAME = "_nds_ledger_stdlib"
+_CAMPAIGN_NAME = "_nds_campaign_stdlib"
+
+
+def _load(name, relpath):
+    mod = sys.modules.get(name)
+    if mod is None:
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(REPO, *relpath))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+    return mod
 
 
 def ledger_mod():
     """The ledger module, loaded without touching the jax import."""
-    mod = sys.modules.get(_NAME)
-    if mod is None:
-        spec = importlib.util.spec_from_file_location(
-            _NAME, os.path.join(REPO, "nds_tpu", "obs", "ledger.py"))
-        mod = importlib.util.module_from_spec(spec)
-        sys.modules[_NAME] = mod
-        spec.loader.exec_module(mod)
-    return mod
+    return _load(_NAME, ("nds_tpu", "obs", "ledger.py"))
+
+
+def campaign_mod():
+    """The campaign-orchestration module (arm model, env fingerprint,
+    manifest) — stdlib-only under the same discipline as the ledger."""
+    return _load(_CAMPAIGN_NAME, ("nds_tpu", "obs", "campaign.py"))

@@ -43,8 +43,15 @@ resume files and power.py --ledger files alike, legacy pre-ledger
 resume lines included), or a JSON dict with a ``"times"`` map
 (BASELINE_TIMES.json / a merged BENCH baseline).
 
+With MORE than two rounds the tool renders the cross-arm table instead
+(every round vs the first, labeled by the arm name recorded in each
+ledger) — the campaign driver's merge view. ``--gate`` stays strictly
+two-round.
+
 Usage:
     python tools/bench_compare.py A.jsonl B.jsonl            # diff report
+    python tools/bench_compare.py base.jsonl arm1.jsonl arm2.jsonl
+                                                             # cross-arm table
     python tools/bench_compare.py A.jsonl B.jsonl --gate     # CI gate
     python tools/bench_compare.py A.jsonl B.jsonl --gate --inject-drift
     python tools/bench_compare.py B.jsonl --emit-perf PERF.md
@@ -224,6 +231,65 @@ def format_compare(cmp, a, b, top=15):
     if len(ranked) > top:
         lines.append(f"# ... {len(ranked) - top} more queries "
                      "(sorted by ratio, worst first)")
+    return lines
+
+
+def round_label(r, fallback=None):
+    """How a round is named in cross-arm output: the arm name RECORDED
+    in its ledger (bench.py's campaign stamp) when present — provenance
+    the artifact carries, not the path it happens to sit at — else the
+    file basename."""
+    return r["meta"].get("arm") or fallback or os.path.basename(r["path"])
+
+
+def format_multi(rounds, top=8):
+    """Cross-arm table over >2 rounds: every round diffed against
+    rounds[0] (the primary arm) with :func:`compare`'s math — one row
+    per arm, plus each arm's worst per-query regressions vs primary.
+    Rows are keyed by :func:`round_label` (recorded arm name first)."""
+    primary = rounds[0]
+    plabel = round_label(primary)
+    lines = [f"# bench_compare cross-arm: {len(rounds)} rounds, "
+             f"primary = {plabel}"]
+    lines.append("")
+    lines.append("| arm | queries | geomean ms | vs primary | hostSyncs "
+                 "| h2d MB | ici MB | end |")
+    lines.append("|---|---|---|---|---|---|---|---|")
+    details = []
+    for r in rounds:
+        label = round_label(r)
+        cmp = compare(primary, r)
+        geo = (_geomean(list(r["times"].values()))
+               if r["times"] else float("nan"))
+        ratio = (f"{cmp['geomean_ratio']:.3f}"
+                 if cmp.get("geomean_ratio") and r is not primary else
+                 ("1.000" if r is primary else "-"))
+        syncs = sum(e.get("hostSyncs", 0) for e in r["evidence"].values())
+        h2d = sum(e.get("bytesH2d", 0)
+                  for e in r["evidence"].values()) / 1e6
+        ici = sum(e.get("bytesIci", 0)
+                  for e in r["evidence"].values()) / 1e6
+        endrec = r["end"]
+        state = (endrec["status"] if endrec else
+                 ("json" if r["path"].endswith(".json") else "KILLED"))
+        lines.append(f"| {label} | {len(r['times'])} | {geo:.1f} "
+                     f"| {ratio} | {syncs} | {h2d:.1f} | {ici:.1f} "
+                     f"| {state} |")
+        if r is primary:
+            continue
+        worst = sorted(cmp["rows"], key=lambda x: x["ratio"],
+                       reverse=True)[:top]
+        moved = [w for w in worst if abs(w["ratio"] - 1.0) >= 0.05]
+        if moved:
+            details.append(f"# {label} vs {plabel} (worst movers):")
+            for w in moved:
+                details.append(
+                    f"#   {w['query']}: {w['a_ms']:.0f} -> "
+                    f"{w['b_ms']:.0f} ms (x{w['ratio']:.2f})")
+        for q, status in cmp.get("now_failing", {}).items():
+            details.append(f"# {label}: {q} ok in {plabel}, {status} here")
+    lines.append("")
+    lines.extend(details)
     return lines
 
 
@@ -719,6 +785,18 @@ def main(argv=None) -> int:
         emit_perf(b, args.emit_perf)
         print(f"# PERF.md regenerated from {args.rounds[0]} -> "
               f"{args.emit_perf} ({len(b['times'])} queries)")
+        return 0
+
+    if len(args.rounds) > 2:
+        # cross-arm table: every round vs the first (primary). The GATE
+        # contract stays strictly two-round — regression thresholds are
+        # a pairwise judgment, and widening them silently would let a
+        # multi-arm invocation skip the real A/B gate.
+        if args.gate or args.inject_drift:
+            ap.error("--gate/--inject-drift take exactly two rounds "
+                     "(A B); the cross-arm table is report-only")
+        for ln in format_multi([load_round(p) for p in args.rounds]):
+            print(ln)
         return 0
 
     if len(args.rounds) != 2:
