@@ -37,8 +37,18 @@ with no device in the loop:
   cache-key completeness (every env knob reachable from a cached
   computation appears in its key). Runtime half:
   ``tools/conc_audit_diff.py``'s threaded stress differential.
+* :mod:`nds_tpu.analysis.num_audit` — value-range/precision abstract
+  interpreter over the same decomposition: proves per statement that
+  every FOR/dict codec fits its priced narrow width, every encoded
+  compare's ``lit - base`` rebase and kernel threshold stays in int64,
+  no SUM/COUNT/AVG accumulator exceeds int64 / f64-exact-integer range
+  through join fan-out, decimal scale is preserved exactly, and the
+  hash partition+shard route bits fit the mixed 32-bit width — plus
+  executable versions of the numeric-safety claims written as comments
+  in ``io/columnar.py`` and ``engine/kernels.py``. Runtime half:
+  ``tools/num_audit_diff.py``'s boundary-value differential.
 
-``tools/lint.py`` runs all seven and gates on new findings against the
+``tools/lint.py`` runs all eight and gates on new findings against the
 checked-in :data:`BASELINE_PATH` (accepted pre-existing findings); code-lint
 findings are suppressible in-source with ``# nds-lint: ignore[rule]``.
 """

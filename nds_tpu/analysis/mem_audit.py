@@ -460,9 +460,12 @@ def stream_partitions_env() -> int | None:
     """``NDS_TPU_STREAM_PARTITIONS``: pins the partition count of every
     partitionable streamed graph (rounded up to a power of two; <= 1
     disables partitioning). Unset = the proof chooses statically
-    (:func:`choose_partitions`). Read at model/pipeline BUILD time."""
+    (:func:`choose_partitions`). Read at model/pipeline BUILD time.
+    Clamped to :data:`_MAX_PARTITIONS` so the partition-bit window of the
+    routing hash stays inside the mixed 32-bit width at any legal setting
+    (num_audit hash-bit rule: ``log2(P) + log2(S) <= 32``)."""
     env = os.environ.get("NDS_TPU_STREAM_PARTITIONS")
-    return _pow2_at_least(int(env)) if env else None
+    return min(_pow2_at_least(int(env)), _MAX_PARTITIONS) if env else None
 
 
 def stream_skew_factor() -> int:
@@ -479,9 +482,11 @@ def stream_shards_env() -> int:
     models the requested count; the runtime additionally requires that
     many local devices (``parallel.exchange.stream_mesh``) and falls back
     to 1 otherwise — the differential harness closes that gap by checking
-    ``StreamEvent.shards`` against the model."""
+    ``StreamEvent.shards`` against the model. Clamped to
+    :data:`_MAX_PARTITIONS` like the partition knob: together the two
+    route windows consume at most 8 + 8 of the 32 mixed hash bits."""
     env = os.environ.get("NDS_TPU_STREAM_SHARDS")
-    return _pow2_at_least(int(env)) if env else 1
+    return min(_pow2_at_least(int(env)), _MAX_PARTITIONS) if env else 1
 
 
 def shard_row_bound(rows: int, n_shards: int, n_partitions: int, k: int,

@@ -193,9 +193,26 @@ def _encoded_compare_views(a: Column, b: Column):
     if enc_side.enc.mode != "for" or plain_side.enc is not None or \
             enc_side.scale != plain_side.scale or plain_side.kind == "f64":
         return None
-    base = jnp.asarray(enc_side.enc.base, dtype=jnp.int64)
+    base_i = int(enc_side.enc.base)
     ev = enc_side.data.astype(jnp.int64)
-    pv = plain_side.data.astype(jnp.int64) - base
+    raw = plain_side.data.astype(jnp.int64)
+    diff = raw - jnp.int64(base_i)
+    # The rebase must SATURATE, not wrap: a plain value near ±2^63 with an
+    # opposite-signed base overflows int64 and lands back inside the code
+    # window with every comparison inverted. The base is a host int, so
+    # only one wrap direction is possible per trace: with base < 0 the
+    # subtraction can only wrap upward (raw > 0 yet diff < 0), with
+    # base > 0 only downward (raw < 0 yet diff > 0). Wrapped values and
+    # all out-of-window values pin to the sentinels -1 / code_max + 1,
+    # strictly outside the code range [0, span] — every comparison
+    # against any code keeps its exact truth value.
+    code_max = jnp.int64((1 << 15) - 1 if enc_side.data.dtype == jnp.int16
+                         else (1 << 31) - 1)
+    if base_i < 0:
+        diff = jnp.where((raw > 0) & (diff < 0), code_max + 1, diff)
+    elif base_i > 0:
+        diff = jnp.where((raw < 0) & (diff > 0), jnp.int64(-1), diff)
+    pv = jnp.clip(diff, jnp.int64(-1), code_max + 1)
     return (ev, pv) if enc_side is a else (pv, ev)
 
 

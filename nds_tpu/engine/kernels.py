@@ -203,7 +203,11 @@ def segment_sum_fused(weights, gids, num_segments: int):
 # happens in an i32 output ref (exact while n*255 < 2^31 => n < 2^23 rows
 # — the one gate), and the i64 recombination runs in XLA on the tiny
 # (9, G) result, wrapping on true-sum overflow exactly like the XLA
-# segment-sum it replaces.
+# segment-sum it replaces. Each arithmetic claim in this paragraph (limb
+# identity, f32-exact partials, the i32 gate, the f32 mantissa limit) is
+# an executable check in ``analysis/num_audit.kernel_claim_checks``; the
+# per-statement accumulator-range proofs that make the wrap-on-overflow
+# caveat unreachable at the audited scale live in the same module.
 
 _LIMB_BITS = 8
 _N_LIMBS = 8            # full int64 coverage: 7 unsigned bytes + signed top
@@ -503,7 +507,12 @@ def hash_mix(h, data):
     Dictionary codes hash as their int32 codes (the whole-table encoding
     makes them value-stable across chunks); floats hash their bit
     pattern. Multiplicative mixing — any chunk-row partitioning keeps
-    the per-partition bound valid, the hash only evens the shares."""
+    the per-partition bound valid, the hash only evens the shares.
+    The 32 mixed bits are split into DISJOINT route windows (low
+    ``log2(P)`` bits pick the partition, the next ``log2(S)`` bits the
+    shard — engine/stream.py); both env knobs are clamped so the two
+    windows always fit: checked per statement (``hash-bits``) and at the
+    clamp itself by ``analysis/num_audit.kernel_claim_checks``."""
     if jnp.issubdtype(data.dtype, jnp.floating):
         data = jax.lax.bitcast_convert_type(
             data, jnp.int64 if data.dtype.itemsize == 8 else jnp.int32)

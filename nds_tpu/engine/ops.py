@@ -1141,7 +1141,9 @@ def agg_count(col: Column | None, gids, ngroups) -> Column:
     Counts are exactly representable in f32 below 2^24 rows, so unlike the
     decimal sums this EXACT aggregate can ride the Pallas MXU kernel —
     count appears in nearly every query (count(*), avg validity), which is
-    what makes the kernel hot on the default exact-decimal bench."""
+    what makes the kernel hot on the default exact-decimal bench. (The
+    2^24 exactness claim and this gate are checked by
+    ``analysis/num_audit.kernel_claim_checks``.)"""
     valid = None if col is None else col.valid
     if int(gids.shape[0]) < (1 << 24):
         from nds_tpu.engine.kernels import pallas_active, segment_sum_fused

@@ -55,7 +55,15 @@ def plan_column_codec(arr, canonical_type: str):
     the overflow guard; an ALL-NULL int column encodes as trivial FOR so
     the static width model never under-prices it). ``arr`` is the WHOLE
     table's column (Array or ChunkedArray): stats and codes are computed
-    once, so the encoding is identical for every chunk sliced from it."""
+    once, so the encoding is identical for every chunk sliced from it.
+
+    Every numeric claim this function makes (the 2^15 / 2^31 - 1 span
+    rules, dict refusal past DICT_MAX_VALUES, all-null/empty trivial FOR,
+    order preservation) is an executable boundary check in
+    ``analysis/num_audit.codec_claim_checks`` — a ``num-claim`` lint
+    finding fires if any of them stops being true — and the per-statement
+    codec-fit proofs mirror the width rules in
+    ``num_audit.codec_width_verdict``."""
     import numpy as np
 
     from nds_tpu import types as _t

@@ -1598,11 +1598,18 @@ def _run_lint(*argv):
 
 
 def test_lint_cli_gate(tmp_path):
-    """The shipped baseline gates clean; a seeded bad template fails."""
-    r = _run_lint("--json", str(tmp_path / "report.json"))
+    """The shipped baseline gates clean; a seeded bad template fails.
+    Rides the same subprocess run to check --num-report plumbing: the
+    proof table on stdout and the ``num_report`` field in --json."""
+    r = _run_lint("--json", str(tmp_path / "report.json"), "--num-report")
     assert r.returncode == 0, r.stdout + r.stderr
+    assert "# num-audit: per-statement value-range/precision proofs" \
+        in r.stdout
+    assert "proven-safe compiled-stream" in r.stdout
     report = json.load(open(tmp_path / "report.json"))
     assert report["pass_counts"]["plan-audit"] >= 1
+    assert report["pass_counts"]["num-audit"] == 0
+    assert len(report["num_report"]) == 103
     assert not report["new"]
 
     seeded = tmp_path / "templates"
@@ -1627,6 +1634,7 @@ def test_lint_cli_format_json(tmp_path):
     assert doc["version"] == 1
     assert set(doc["pass_counts"]) == {"plan-audit", "exec-audit",
                                        "mem-audit", "perf-audit",
+                                       "num-audit",
                                        "jax-lint", "driver-audit",
                                        "conc-audit"}
     entries = doc["findings"]
@@ -2081,7 +2089,11 @@ def test_lint_changed_covers_kernels():
               # campaign driver: its arm-failure handling is a client
               # of the swallowed-fault contract and its fingerprint
               # stamp is the provenance every ledger record keys on
-              "nds_tpu/obs/campaign.py"):
+              "nds_tpu/obs/campaign.py",
+              # numeric-safety layer: the value-range interpreter and
+              # the saturating encoded-compare rebase it models
+              "nds_tpu/analysis/num_audit.py",
+              "nds_tpu/engine/exprs.py"):
         assert p.startswith(mod._CORPUS_ROOTS), \
             f"{p} not covered by _CORPUS_ROOTS"
 
@@ -2398,16 +2410,126 @@ def test_conc_audit_differential_harness():
 
 
 def test_lint_jobs_thread_pool_matches_sequential():
-    """--jobs N runs the seven passes in a thread pool with identical
+    """--jobs N runs the eight passes in a thread pool with identical
     findings/counts — the analysis layer passing its own audit, live."""
     import importlib.util
     path = os.path.join(REPO, "tools", "lint.py")
     spec = importlib.util.spec_from_file_location("lint_tool_j", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    f1, c1, _r1, _m1, _p1, _e1 = mod.run_passes(jobs=1)
-    f6, c6, _r6, _m6, _p6, _e6 = mod.run_passes(jobs=6)
+    f1, c1, _r1, _m1, _p1, _n1, _e1 = mod.run_passes(jobs=1)
+    f6, c6, _r6, _m6, _p6, _n6, _e6 = mod.run_passes(jobs=6)
     assert c1 == c6
     assert [str(f) for f in f1] == [str(f) for f in f6]
     assert "conc-audit" in c1
     assert "perf-audit" in c1
+    assert "num-audit" in c1
+
+
+# ---------------------------------------------------------------------------
+# numeric-safety audit: value-range/precision proofs + boundary lockstep
+# ---------------------------------------------------------------------------
+
+
+def test_num_ival_abstraction():
+    """The interval/scale/mass lattice the proofs run on: scaled decimal
+    endpoints, additive mass under union, exact x10^d rescaling, and the
+    codec width rules at their edges."""
+    from nds_tpu.analysis.num_audit import (FOR16_SPAN, FOR32_SPAN, IVal,
+                                            codec_width_verdict,
+                                            column_interval)
+    iv = column_interval("ss_ext_sales_price", "decimal(7,2)", {})
+    assert (iv.lo, iv.hi, iv.scale) == (-(10 ** 7 - 1), 10 ** 7 - 1, 2)
+    a = IVal(-3, 5, mass=10)
+    b = IVal(0, 9, mass=7)
+    u = a.union(b)
+    assert (u.lo, u.hi, u.mass) == (-3, 9, 17)
+    r = IVal(-25, 50, scale=1).at_scale(3)
+    assert (r.lo, r.hi, r.scale) == (-2500, 5000, 3)
+    # width rules at the exact spans the codec refuses past
+    assert codec_width_verdict(IVal(0, FOR16_SPAN - 1), 8)[0] == 2
+    assert codec_width_verdict(IVal(0, FOR16_SPAN), 8)[0] == 4
+    assert codec_width_verdict(IVal(0, FOR32_SPAN - 1), 8)[0] == 4
+    assert codec_width_verdict(IVal(0, FOR32_SPAN), 8) is None
+    assert codec_width_verdict(None, 8) is None
+
+
+def test_num_audit_corpus_proves_clean():
+    """Every corpus statement's numeric proofs land host-only with ZERO
+    findings — no codec overflow, no unprovable accumulator, no hash-bit
+    spill — and the claim checks hold: the shipped tree's numeric story
+    is fully proven, so the baseline carries nothing."""
+    import time
+    from nds_tpu.analysis.num_audit import (audit_num_corpus, check_counts,
+                                            claim_findings,
+                                            reports_to_findings)
+    t0 = time.time()
+    reports = audit_num_corpus()
+    elapsed = time.time() - t0
+    assert len(reports) == 103
+    assert reports_to_findings(reports) == []
+    assert claim_findings() == []
+    assert elapsed < 60, f"host-only audit took {elapsed:.1f}s"
+    # the proof histogram is a tier-1 contract, pinned like the perf
+    # bottleneck counts: a rule change that silently drops checks (or
+    # un-proves one) must fail loudly — update ONLY together with the
+    # matching engine/model change (the lockstep rule)
+    assert check_counts(reports) == {
+        "agg": (287, 287), "arith": (61, 61), "codec": (406, 406),
+        "hash-bits": (150, 150), "rebase": (35, 35), "scale": (24, 24)}
+    assert sum(1 for r in reports if r.proven_safe) == 96
+
+
+def test_num_audit_scale_lockstep():
+    """MAX_DEC_SCALE mirrors the engine's decimal-scale ceiling so a
+    widened runtime scale cannot outrun the static proofs silently."""
+    from nds_tpu.analysis.num_audit import MAX_DEC_SCALE
+    from nds_tpu.engine import exprs
+    assert MAX_DEC_SCALE == exprs._MAX_DEC_SCALE
+
+
+def _load_num_diff(name="num_audit_diff_t"):
+    path = os.path.join(REPO, "tools", "num_audit_diff.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_num_audit_differential_harness():
+    """The boundary-value lockstep: every arm of the sweep (base,
+    fused-kernel, sharded, encoded-off) returns bit-identical rows to
+    the plain-width eager reference over the adversarial tables (FOR
+    spans at the int16 edge over 10^9 / negative bases, full dict code
+    space, decimal(7,2) extremes, a hot-hash key), and the static
+    verdicts agree exactly with the runtime overflow-flag evidence."""
+    import numpy as np
+    mod = _load_num_diff()
+    tables = mod._boundary_tables(np.random.default_rng(1729))
+    expect = mod.reference(tables)
+    arms = [mod.run_arm(name, env_kv, tables)
+            for name, env_kv in mod._ARMS if name != "sharded"]
+    import jax
+    if jax.device_count() >= 2:
+        arms.append(mod.run_arm("sharded",
+                                {"NDS_TPU_STREAM_SHARDS": "2"}, tables))
+    reports = mod.static_verdicts(
+        {k: t.num_rows for k, t in tables.items()})
+    ok, lines = mod.compare(expect, arms, reports, arms[0])
+    assert ok, "\n".join(lines)
+    assert all(r.proven for r in reports)
+    # direction A of the drift contract: an explicit accumulator
+    # ceiling forces the runtime overflow rerun, contradicting the
+    # (still proven) static verdicts — the harness must flag it
+    with mod._env(NDS_TPU_STREAM_ACC_ROWS="1024"):
+        over = mod.run_arm("base+acc-ceiling", {}, tables)
+    ok_a, lines_a = mod.compare(expect, [over], reports, over)
+    assert not ok_a, "runtime overflow drift fixture failed to fail"
+    assert any("overflow rerun" in ln for ln in lines_a)
+    # direction B: widened static ranges (row bounds x10^9) un-prove
+    # the accumulator checks against a clean runtime — flagged too
+    drift = mod.static_verdicts(
+        {k: t.num_rows for k, t in tables.items()}, inflate=10 ** 9)
+    ok_b, lines_b = mod.compare(expect, [arms[0]], drift, arms[0])
+    assert not ok_b, "widened-range drift fixture failed to fail"
+    assert any("statically unproven" in ln for ln in lines_b)

@@ -491,6 +491,32 @@ def test_ab_perf_audit_inject_drift_must_fail(bench_compare, ab_ledger):
     assert any("EXACTNESS LOST" in ln for ln in lines)
 
 
+def test_ab_ledger_overflow_evidence_matches_num_audit(bench_compare,
+                                                       ab_ledger):
+    """--audit-num: every pinned A/B statement's numeric proofs (codec
+    fit, rebase, accumulator range, hash bits) must hold at the ledger's
+    own rowBounds, and the recorded scans must carry NO bound-bucket
+    overflow rerun — the static verdict and the recorded overflow-flag
+    evidence agree on the durable artifact."""
+    ok, lines = bench_compare.audit_num(ab_ledger)
+    assert ok, "\n".join(lines)
+    assert any(ln.startswith("ok [ab1]") and "checks proven" in ln
+               for ln in lines)
+    assert sum(1 for ln in lines if ln.startswith("ok [")) == 14
+
+
+def test_ab_num_audit_inject_drift_must_fail(bench_compare, ab_ledger):
+    """Both drift directions: stamped overflow reasons under proven
+    verdicts, and x10^9 row bounds (widened static ranges) over a clean
+    record — each MUST be rejected on its own."""
+    ok_r, lines_r = bench_compare.audit_num(ab_ledger, inject="runtime")
+    assert not ok_r, "stamped overflow evidence must be rejected"
+    assert any("overflow rerun" in ln for ln in lines_r)
+    ok_s, lines_s = bench_compare.audit_num(ab_ledger, inject="static")
+    assert not ok_s, "widened static ranges must be rejected"
+    assert any("statically unproven" in ln for ln in lines_s)
+
+
 # ---------------------------------------------------------------------------
 # evidence schema round-trip: every event field reaches the ledger
 # ---------------------------------------------------------------------------

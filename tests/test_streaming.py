@@ -217,6 +217,70 @@ def test_encoded_chunk_codecs():
     assert plan_column_codec(pa.array(["x", "y"]), "string") is None
 
 
+def test_encoded_codec_boundaries():
+    """Satellite of analysis/num_audit: the EXACT codec edges the static
+    width rules promise, end-to-end through padded_chunks — span
+    2^15 - 1 fits int16 / span 2^15 widens to int32, exactly 4096
+    distinct values dict-encode with code 4095 live / 4097 refuse,
+    an all-negative span rebases bit-exactly, and a full-range
+    decimal(7,2) survives the scaled FOR round-trip to the cent."""
+    from decimal import Decimal
+
+    from nds_tpu.io.columnar import DICT_MAX_VALUES, plan_column_codec
+
+    span16 = (1 << 15) - 1
+    n = 3000
+    base = 1_000_000_000
+    edge16 = base + (np.arange(n) * 131) % (span16 + 1)
+    edge16[0], edge16[1] = base, base + span16       # both endpoints live
+    over16 = edge16.copy()
+    over16[2] = base + span16 + 1                    # span 2^15: one too far
+    neg = -(40_000) + (np.arange(n)[::-1] * 37) % (span16 + 1)
+    cents = (np.arange(n) * 6673) % (2 * 10 ** 7) - (10 ** 7 - 1)
+    cents[0], cents[1] = 10 ** 7 - 1, -(10 ** 7 - 1)
+    t = pa.table({
+        "edge16": pa.array(edge16, pa.int64()),
+        "over16": pa.array(over16, pa.int64()),
+        "neg": pa.array(neg, pa.int64()),
+        "dec": pa.array([Decimal(int(c)) / 100 for c in cents],
+                        pa.decimal128(7, 2)),
+    })
+    ct = ChunkedTable(t, chunk_rows=1024, canonical_types={
+        "edge16": "int64", "over16": "int64", "neg": "int64",
+        "dec": "decimal(7,2)"})
+    c0 = list(ct.padded_chunks())[0]
+    # span exactly 2^15 - 1: int16 FOR, both endpoints round-trip
+    assert c0["edge16"].enc.mode == "for"
+    assert c0["edge16"].data.dtype == np.int16
+    np.testing.assert_array_equal(
+        np.asarray(c0["edge16"].plain().data)[:1024], edge16[:1024])
+    # span exactly 2^15: int16 refused, int32 takes it bit-exactly
+    assert c0["over16"].data.dtype == np.int32
+    np.testing.assert_array_equal(
+        np.asarray(c0["over16"].plain().data)[:1024], over16[:1024])
+    # all-negative span rebases against a negative base exactly
+    assert c0["neg"].enc is not None
+    np.testing.assert_array_equal(
+        np.asarray(c0["neg"].plain().data)[:1024], neg[:1024])
+    # full-range decimal(7,2): int32 FOR over the scaled ints, exact to
+    # the cent at both extremes
+    assert c0["dec"].enc.mode == "for"
+    assert c0["dec"].data.dtype == np.int32
+    np.testing.assert_array_equal(
+        np.asarray(c0["dec"].plain().data)[:1024], cents[:1024])
+    # dict code space: exactly DICT_MAX_VALUES distinct values encode
+    # (top code 4095 is a live value-table index); one more refuses
+    vals = np.arange(DICT_MAX_VALUES) * (1 << 40)
+    got = plan_column_codec(pa.array(vals, pa.int64()), "int64")
+    assert got is not None and got[2].mode == "dict"
+    assert got[0].dtype == np.int16
+    assert int(got[0].max()) == DICT_MAX_VALUES - 1
+    np.testing.assert_array_equal(
+        np.asarray(got[2].values)[np.asarray(got[0])], vals)
+    more = np.append(vals, (DICT_MAX_VALUES + 9) * (1 << 40))
+    assert plan_column_codec(pa.array(more, pa.int64()), "int64") is None
+
+
 def test_encoded_compiled_matches_unencoded_and_shrinks_h2d():
     """Acceptance: A/B templates run the ENCODED compiled path bit-for-
     bit equal to the decoded run under NDS_TPU_STREAM_STRICT=1, and

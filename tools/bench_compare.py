@@ -36,7 +36,13 @@ comparison ENFORCEABLE:
   ``bytesH2d`` must EQUAL the padded-chunk closed form at the live wire
   widths, and the sharded records' ``bytesIci`` must match the
   exchange+reduce collective arithmetic — so a completed campaign's
-  byte evidence carries its static denominator, not just its bounds.
+  byte evidence carries its static denominator, not just its bounds;
+* **--audit-num**: re-check the same recorded ledger against the
+  numeric-safety proofs (nds_tpu/analysis/num_audit.py): a statement
+  the auditor proves must carry NO recorded ``bound-bucket overflow``
+  rerun, and a clean record must never sit under an unproven verdict —
+  the static/runtime overflow-flag agreement of tools/num_audit_diff.py
+  applied to the durable artifact.
 
 Round inputs: a campaign ledger JSONL (nds_tpu/obs/ledger.py — bench.py
 resume files and power.py --ledger files alike, legacy pre-ledger
@@ -58,6 +64,7 @@ Usage:
     python tools/bench_compare.py --record-ab ab.jsonl       # CPU mini-sweep
     python tools/bench_compare.py --audit-ab ab.jsonl [--inject-drift]
     python tools/bench_compare.py --audit-perf ab.jsonl [--inject-drift]
+    python tools/bench_compare.py --audit-num ab.jsonl [--inject-drift]
 """
 
 import argparse
@@ -696,6 +703,65 @@ def audit_perf(path, inject=False):
     return ok, lines
 
 
+def audit_num(path, inject=None):
+    """Cross-validate a recorded A/B ledger against the static NUMERIC
+    safety proofs: a statement num_audit proves (every codec/rebase/
+    accumulator/hash-bit check) must carry NO recorded overflow-flag
+    evidence — no streamed scan that took the ``bound-bucket overflow``
+    eager rerun — and a clean record must never sit under an unproven
+    verdict. ``inject`` is the two-direction drift self-test that MUST
+    fail: ``"runtime"`` stamps the overflow reason onto every recorded
+    scan (proven verdicts contradicted), ``"static"`` inflates the
+    ledger's own row bounds x10^9 so the accumulator proofs fail against
+    the clean record. Returns (ok, lines)."""
+    from nds_tpu.obs.ledger import load_ledger
+
+    data = load_ledger(path)
+    mod = _load_ab_module()
+    queries = mod._STREAM_AB_QUERIES
+    row_bounds = {str(k): int(v) for k, v in
+                  (data.meta.get("rowBounds") or {}).items()}
+    if inject == "static":
+        row_bounds = {k: v * 10 ** 9 for k, v in row_bounds.items()}
+    with mod._forced_stream_partitions():
+        from nds_tpu.analysis.mem_audit import MemModel
+        from nds_tpu.analysis.num_audit import NumAuditor
+        auditor = NumAuditor(streamed={"store_sales"},
+                             model=MemModel(row_bounds=row_bounds))
+        reports = [auditor.audit_sql(sql, query=f"ab{i + 1}")
+                   for i, (sql, _m) in enumerate(queries)]
+    ok = True
+    lines = []
+    for i, (sql, _must) in enumerate(queries):
+        name = f"ab{i + 1}"
+        rec = data.queries.get(name)
+        rep = reports[i]
+        if rec is None:
+            ok = False
+            lines.append(f"MISMATCH [{name}] no ledger record")
+            continue
+        reasons = [s.get("reason", "") for s in
+                   (rec.get("streamedScans") or [])]
+        if inject == "runtime":
+            reasons = ["bound-bucket overflow" for _ in reasons] or \
+                ["bound-bucket overflow"]
+        over = any(r == "bound-bucket overflow" for r in reasons)
+        if rep.proven and over:
+            ok = False
+            lines.append(f"MISMATCH [{name}] statically proven but the "
+                         "ledger records a bound-bucket overflow rerun")
+        elif not rep.proven and not over:
+            bad = [c for c in rep.checks if not c.proven]
+            what = f"{bad[0].kind} {bad[0].subject}" if bad else "?"
+            ok = False
+            lines.append(f"MISMATCH [{name}] statically unproven "
+                         f"({what}) against a clean ledger record")
+        else:
+            lines.append(f"ok [{name}] {len(rep.checks)} checks proven, "
+                         "no overflow evidence recorded")
+    return ok, lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="diff two campaign evidence ledgers / bench rounds; "
@@ -731,6 +797,10 @@ def main(argv=None) -> int:
                     help="cross-validate a recorded A/B ledger's byte "
                     "evidence against the perf_audit static cost model "
                     "(h2d equality, ICI exchange+reduce arithmetic)")
+    ap.add_argument("--audit-num", metavar="PATH",
+                    help="cross-validate a recorded A/B ledger's "
+                    "overflow-flag evidence against the num_audit "
+                    "value-range proofs (proven <=> no overflow rerun)")
     args = ap.parse_args(argv)
 
     if args.record_ab:
@@ -776,6 +846,32 @@ def main(argv=None) -> int:
         print("# cost-model check FAILED: ledger byte evidence differs "
               "from the static predictions (model drift or engine "
               "regression)")
+        return 1
+
+    if args.audit_num:
+        if args.inject_drift:
+            # both drift directions must be rejected for exit 0
+            ok_r, lines_r = audit_num(args.audit_num, inject="runtime")
+            ok_s, lines_s = audit_num(args.audit_num, inject="static")
+            for ln in lines_r + lines_s:
+                print(ln)
+            if ok_r or ok_s:
+                print("# DRIFT FIXTURE FAILED TO FAIL: the numeric "
+                      "evidence check cannot catch a drifted verdict")
+                return 1
+            print("# both drift directions correctly rejected (numeric "
+                  "evidence check is live)")
+            return 0
+        ok, lines = audit_num(args.audit_num)
+        for ln in lines:
+            print(ln)
+        if ok:
+            print("# ledger overflow evidence agrees with the num_audit "
+                  "static verdicts")
+            return 0
+        print("# numeric evidence check FAILED: a static verdict "
+              "contradicts the recorded overflow evidence (model drift "
+              "or engine regression)")
         return 1
 
     if args.emit_perf:

@@ -1,7 +1,7 @@
 # Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
-"""Static analysis gate: plan/exec/mem/conc/perf auditors + engine/driver lint.
+"""Static analysis gate: plan/exec/mem/conc/perf/num auditors + engine/driver lint.
 
-Runs the seven :mod:`nds_tpu.analysis` passes entirely on host (no device,
+Runs the eight :mod:`nds_tpu.analysis` passes entirely on host (no device,
 no data) and exits nonzero when any finding is NOT covered by the
 checked-in baseline (``nds_tpu/analysis/baseline.json``) — the accepted
 pre-existing findings. New code must come in clean; accepting a new
@@ -19,6 +19,8 @@ Usage:
                                               # bounds (mem-audit)
     python tools/lint.py --perf-report        # per-statement byte totals +
                                               # roofline walls (perf-audit)
+    python tools/lint.py --num-report         # per-statement value-range /
+                                              # precision proofs (num-audit)
     python tools/lint.py --changed            # lint only files in the
                                               # current git diff
     python tools/lint.py --jobs 6             # run the passes in a thread
@@ -58,6 +60,10 @@ from nds_tpu.analysis.mem_audit import (audit_mem_corpus,  # noqa: E402
                                         format_mem_report)
 from nds_tpu.analysis.mem_audit import \
     reports_to_findings as mem_reports_to_findings  # noqa: E402
+from nds_tpu.analysis.num_audit import (audit_num_corpus,  # noqa: E402
+                                        claim_findings, format_num_report)
+from nds_tpu.analysis.num_audit import \
+    reports_to_findings as num_reports_to_findings  # noqa: E402
 from nds_tpu.analysis.perf_audit import (audit_perf_corpus,  # noqa: E402
                                          format_perf_report)
 from nds_tpu.analysis.perf_audit import \
@@ -126,6 +132,14 @@ def git_changed_files():
 # static cost model whose byte predictions tools/perf_audit_diff.py
 # holds byte-exact against StreamEvent evidence — cost-model edits
 # rerun the corpus passes so the bottleneck histogram pin stays honest.
+# nds_tpu/analysis/num_audit.py (explicit for the same reason) is the
+# value-range/precision interpreter whose codec-width, rebase and
+# accumulator proofs tools/num_audit_diff.py holds against runtime
+# overflow-flag evidence and boundary-value execution — numeric-rule
+# edits rerun the corpus passes so a widened range never ships unproven.
+# nds_tpu/engine/exprs.py (same rationale, named despite the engine
+# prefix): the saturating encoded-compare rebase it implements is the
+# exact semantics num_audit's rebase checks assume.
 # nds_tpu/obs/campaign.py (explicit for the same reason) is the
 # unattended multi-arm driver: its arm-failure handling is a direct
 # client of the swallowed-fault rule's contract (bench-child seam,
@@ -141,7 +155,9 @@ _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/listener.py", "nds_tpu/io/columnar.py",
                  "nds_tpu/io/chunk_store.py",
                  "nds_tpu/parallel/", "nds_tpu/obs/",
-                 "nds_tpu/obs/campaign.py")
+                 "nds_tpu/obs/campaign.py",
+                 "nds_tpu/analysis/num_audit.py",
+                 "nds_tpu/engine/exprs.py")
 
 
 def run_passes(template_dir=None, changed=None, want_reports=False,
@@ -154,13 +170,15 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
     (templates, sources) and appends only to its own lists, the exact
     discipline the conc-audit pass itself enforces — findings stay in
     the fixed pass order either way. Returns (findings, pass counts,
-    exec reports, mem reports, perf reports, elapsed seconds)."""
+    exec reports, mem reports, perf reports, num reports, elapsed
+    seconds)."""
     t0 = time.time()
     findings = []
     counts = {}
     reports = []
     mem_reports = []
     perf_reports = []
+    num_reports = []
     corpus_affected = (
         changed is None or template_dir is not None or want_reports
         or any(c.startswith(_CORPUS_ROOTS) for c in changed))
@@ -176,6 +194,10 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
     def run_perf():
         perf_reports.extend(audit_perf_corpus(template_dir))
         return perf_reports_to_findings(perf_reports)
+
+    def run_num():
+        num_reports.extend(audit_num_corpus(template_dir))
+        return num_reports_to_findings(num_reports) + claim_findings()
 
     def run_jax():
         if changed is None:
@@ -204,6 +226,7 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
         passes.append(("exec-audit", run_exec))
         passes.append(("mem-audit", run_mem))
         passes.append(("perf-audit", run_perf))
+        passes.append(("num-audit", run_num))
     passes.append(("jax-lint", run_jax))
     passes.append(("driver-audit", run_drivers))
     # the concurrency audit is a whole-package pass: any nds_tpu edit
@@ -222,7 +245,7 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
         counts[name] = len(got)
         findings.extend(got)
     return (findings, counts, reports, mem_reports, perf_reports,
-            time.time() - t0)
+            num_reports, time.time() - t0)
 
 
 def _aggregate(findings, new):
@@ -266,6 +289,10 @@ def main(argv=None) -> int:
     ap.add_argument("--perf-report", action="store_true",
                     help="print the perf-audit per-statement byte totals, "
                     "roofline walls and static bottleneck tags")
+    ap.add_argument("--num-report", action="store_true",
+                    help="print the num-audit per-statement value-range/"
+                    "precision proofs (codec fit, rebase, accumulators, "
+                    "hash route bits)")
     ap.add_argument("--changed", action="store_true",
                     help="fast path: lint only files in the current git "
                     "diff (full run when not in a git checkout)")
@@ -290,11 +317,11 @@ def main(argv=None) -> int:
 
     changed = git_changed_files() if args.changed else None
 
-    findings, counts, reports, mem_reports, perf_reports, elapsed = \
-        run_passes(
+    findings, counts, reports, mem_reports, perf_reports, num_reports, \
+        elapsed = run_passes(
             args.templates, changed=changed,
             want_reports=(args.stream_report or args.mem_report
-                          or args.perf_report),
+                          or args.perf_report or args.num_report),
             jobs=max(args.jobs, 1))
 
     # diff against the PRE-update baseline so a --json report written
@@ -316,6 +343,8 @@ def main(argv=None) -> int:
             doc["mem_report"] = [r.to_dict() for r in mem_reports]
         if perf_reports:
             doc["perf_report"] = [r.to_dict() for r in perf_reports]
+        if num_reports:
+            doc["num_report"] = [r.to_dict() for r in num_reports]
         with open(args.json, "w") as f:
             json.dump(doc, f, indent=1)
 
@@ -337,6 +366,8 @@ def main(argv=None) -> int:
         print(format_mem_report(mem_reports), file=out)
     if args.perf_report and perf_reports:
         print(format_perf_report(perf_reports), file=out)
+    if args.num_report and num_reports:
+        print(format_num_report(num_reports), file=out)
     for f in new:
         print(f"NEW {f}", file=out)
     n_err = sum(1 for f in new if f.severity == "error")
@@ -354,6 +385,8 @@ def main(argv=None) -> int:
             doc["mem_report"] = [r.to_dict() for r in mem_reports]
         if args.perf_report and perf_reports:
             doc["perf_report"] = [r.to_dict() for r in perf_reports]
+        if args.num_report and num_reports:
+            doc["num_report"] = [r.to_dict() for r in num_reports]
         print(json.dumps(doc, indent=1))
     if new:
         print("# gate FAILED: fix the findings above, suppress with "
