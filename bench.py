@@ -99,6 +99,15 @@ def campaign_mod():
     return _cm()
 
 
+def metrics_mod():
+    """The live-metrics registry (nds_tpu/obs/metrics.py, stdlib-only),
+    by file path: rolling throughput for the heartbeat, per-query
+    ``metrics`` ledger records, and the NDS_TPU_METRICS_FILE exporter
+    the heartbeat drives — all without touching jax in the parent."""
+    from tools._ledger_load import metrics_mod as _mm
+    return _mm()
+
+
 def restart_backoff_s(restart_n: int) -> float:
     """Deterministic-JITTERED backoff before child restart ``restart_n``
     (2nd start onwards): exponential base (NDS_BENCH_RESTART_BACKOFF_S,
@@ -714,6 +723,12 @@ def run_parent(t_entry):
     # heartbeat status snapshot, updated by the main loop and read by the
     # heartbeat thread (plain dict: GIL-atomic single-key writes)
     live = {"query": None, "done": len(times), "total": 0}
+    # live-metrics registry (nds_tpu/obs/metrics.py): fed as results
+    # arrive in THIS loop (the parent's existing evidence point), read
+    # by the heartbeat for rolling queries/min + EWMA wall and exported
+    # to NDS_TPU_METRICS_FILE on the heartbeat cadence
+    metrics_reg = metrics_mod().default()
+    metrics_reg.reset()
 
     def on_signal(signum, frame):
         # an external `timeout` kill lands here: flush the completed
@@ -747,10 +762,15 @@ def run_parent(t_entry):
     hb_interval = float(os.environ.get("NDS_BENCH_HEARTBEAT_S", "15"))
     heartbeat = None
     if hb_interval > 0:
+        # progress context plus the registry's rolling throughput
+        # (queries/min, EWMA query wall) — the rolling numbers replace
+        # the static counters as the liveness throughput signal in both
+        # the ledger progress record and the stderr line
         heartbeat = ledger_mod().Heartbeat(
             hb_interval, ledger=ledger,
-            status=lambda: {k: v for k, v in live.items()
-                            if v is not None}).start()
+            status=lambda: {**{k: v for k, v in live.items()
+                               if v is not None},
+                            **metrics_reg.heartbeat_rollup()}).start()
     attempts = {}
     aborted = None
     setup_fails = 0
@@ -820,6 +840,8 @@ def run_parent(t_entry):
                     cause = f"child crashed (exit {child.proc.returncode})"
                 print(f"# {name} aborted ({cause}); restarting child",
                       file=sys.stderr)
+                metrics_reg.inc("queries.total")
+                metrics_reg.inc(f"queries.{status}")
                 child.stop()
                 if ledger is not None:
                     rec = {"error": cause, "budgetS": round(deadline, 1),
@@ -839,13 +861,30 @@ def run_parent(t_entry):
                 perf[msg["name"]] = {k: msg[k]
                                      for k in PERF_KEYS if k in msg}
                 live["done"] = len(times)
+                M = metrics_mod()
+                metrics_reg.inc("queries.total")
+                metrics_reg.inc("queries.ok")
+                metrics_reg.observe(M.QUERY_WALL, msg["ms"])
+                if msg.get("syncWaitMs"):
+                    metrics_reg.observe(M.SYNC_WAIT, msg["syncWaitMs"])
+                if msg.get("faultEvents"):
+                    metrics_reg.inc("faults.total",
+                                    len(msg["faultEvents"]))
                 if ledger is not None:
                     ledger.query(msg["name"], status="ok",
                                  **{k: v for k, v in msg.items()
                                     if k != "name"})
+                    # the rolling rollup as of this query: queries/min,
+                    # rolling wall quantiles, EWMA — the per-query
+                    # metrics record (same vocabulary as power.py's)
+                    ledger.metrics(scope="query", query=msg["name"],
+                                   **metrics_reg.query_rollup())
             else:
                 print(f"# {name} failed: {msg.get('error')}",
                       file=sys.stderr)
+                metrics_reg.inc("queries.total")
+                metrics_reg.inc("queries.timeout" if msg.get("timeout")
+                                else "queries.error")
                 if ledger is not None:
                     # an in-process watchdog expiry (StatementTimeout)
                     # is a classified `timeout`, not an `error`: the

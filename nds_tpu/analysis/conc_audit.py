@@ -147,6 +147,15 @@ ENTRY_POINTS = (
     ("obs/trace.py", "attach"),
     ("obs/ledger.py", "beat"),               # bench heartbeat thread
     ("obs/ledger.py", "_loop"),
+    # the live-metrics registry: inc/observe run on every driver thread
+    # (query loop, heartbeat, admission waits) while snapshot/export
+    # reads from the heartbeat thread. All counter/gauge/histogram
+    # state is INSTANCE-scoped on the Registry behind its ONE dedicated
+    # _lock; module level holds only import-time constants (EDGES, the
+    # metric-name vocabulary) and the _DEFAULT instance binding — so the
+    # whole-module inventory stays at zero findings; the runtime half is
+    # conc_audit_diff's "metrics" lock probe (threaded-quantile drift).
+    ("obs/metrics.py", ""),
     # the campaign driver: single-threaded BY CONTRACT — all run state
     # (manifest dict, in-flight child handle) is local to run_campaign,
     # module level holds only import-time constants (PRESETS, knob

@@ -22,11 +22,22 @@ pays; ``tests/test_obs.py`` proves sync-count parity traced vs untraced).
   drivers write and every post-hoc tool (``tools/bench_compare.py``,
   ``tools/trace_report.py``, ``tools/sync_profile.py``) reads, plus the
   campaign heartbeat thread.
+* :mod:`nds_tpu.obs.metrics` — the live half: the process-local
+  rolling-rollup registry (counters, gauges, mergeable fixed-bucket
+  histograms with deterministic p50/p95/p99) fed only at existing
+  drain/evidence points, snapshotted atomically to
+  ``NDS_TPU_METRICS_FILE`` for the mid-run monitor
+  (``tools/obs_live.py``) and carried in the ledger as ``metrics``
+  records.
 """
 
 from nds_tpu.obs.ledger import (LEDGER_VERSION, Heartbeat,  # noqa: F401
                                 Ledger, LedgerData, LedgerError,
                                 evidence_from_scans, load_ledger)
+from nds_tpu.obs.metrics import (METRICS_VERSION, Registry,  # noqa: F401
+                                 export_live, merge_hist_snapshots,
+                                 quantile_from_buckets)
+from nds_tpu.obs.metrics import default as default_registry  # noqa: F401
 from nds_tpu.obs.trace import (NULL_SPAN, SpanRecord, SyncSite,  # noqa: F401
                                annotate, attach, drain_spans, on,
                                set_enabled, span, unattributed)

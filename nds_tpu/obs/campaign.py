@@ -272,6 +272,7 @@ def arm_paths(campaign_dir: str, name: str) -> dict:
     return {"dir": d,
             "ledger": os.path.join(d, "ledger.jsonl"),
             "traces": os.path.join(d, "traces"),
+            "metrics": os.path.join(d, "metrics.json"),
             "log": os.path.join(d, "bench.log")}
 
 
@@ -485,6 +486,10 @@ def run_campaign(arms, campaign_dir, bench_cmd=None, env=None,
         child_env["NDS_CAMPAIGN_ARM"] = arm.name
         child_env["NDS_BENCH_RESULTS_JSONL"] = paths["ledger"]
         child_env["NDS_BENCH_TRACE_DIR"] = paths["traces"]
+        # per-arm live status file (atomic snapshot on the heartbeat
+        # cadence): tools/obs_live.py renders the campaign directory as
+        # a mid-run per-arm progress table
+        child_env["NDS_TPU_METRICS_FILE"] = paths["metrics"]
         rec["status"] = "running"
         write_manifest(campaign_dir, manifest)
         t0 = time.time()

@@ -34,6 +34,9 @@ Record kinds and their required fields (beyond ``v``/``kind``/``t``):
 meta     driver; optional platform, scale, anything else
 query    name, status ("ok" | "error" | "timeout")
 progress (heartbeat) — optional query/done/total/elapsedS
+metrics  scope ("query" | "stream"), metricsV — live-metrics
+         rollup (nds_tpu/obs/metrics.py): rolling or stream
+         QPS / quantile / queue-wait / timeout-shed fields
 end      status ("completed" | "aborted")
 ======== ==================================================
 
@@ -57,6 +60,11 @@ import threading
 import time
 
 LEDGER_VERSION = 1
+# the live-metrics rollup schema carried by `metrics` records — its own
+# gate, separate from the envelope version: rollup shapes (bucket
+# layout, quantile keys) can evolve without re-versioning every record.
+# Must match nds_tpu/obs/metrics.py METRICS_VERSION (pinned by test).
+METRICS_VERSION = 1
 
 
 def _faults_mod():
@@ -82,11 +90,37 @@ def _faults_mod():
     spec.loader.exec_module(mod)
     return mod
 
+
+def _metrics_mod():
+    """The live-metrics registry (``nds_tpu/obs/metrics.py``) under the
+    same dual-identity discipline as :func:`_faults_mod`: reuse the
+    package import when the engine loaded it, else the stdlib-only
+    file-path load — SHARING the canonical ``sys.modules`` name with
+    ``tools/_ledger_load.py`` so the bench parent's feeds and the
+    heartbeat exporter see the one process-default registry."""
+    m = sys.modules.get("nds_tpu.obs.metrics")
+    if m is not None:
+        return m
+    m = sys.modules.get("_nds_metrics_stdlib")
+    if m is not None:
+        return m
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "metrics.py")
+    spec = importlib.util.spec_from_file_location(
+        "_nds_metrics_stdlib", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_nds_metrics_stdlib"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 # record kinds -> required fields (beyond v/kind/t)
 _REQUIRED = {
     "meta": ("driver",),
     "query": ("name", "status"),
     "progress": (),
+    "metrics": ("scope",),
     "end": ("status",),
 }
 
@@ -124,6 +158,14 @@ def _validate(rec: dict, lineno: int) -> dict:
     if kind == "end" and rec["status"] not in _END_STATUSES:
         raise LedgerError(f"ledger line {lineno}: end status "
                           f"{rec['status']!r} not in {_END_STATUSES}")
+    if kind == "metrics" and rec.get("metricsV") != METRICS_VERSION:
+        # same refusal discipline as the envelope version: silently
+        # misreading an evolved rollup shape would corrupt a comparison
+        raise LedgerError(
+            f"ledger line {lineno}: metrics record version "
+            f"{rec.get('metricsV')!r} is not the supported version "
+            f"{METRICS_VERSION} — refusing to guess at an unknown "
+            "rollup shape (upgrade the reader, or re-record)")
     return rec
 
 
@@ -204,6 +246,7 @@ class LedgerData:
         self.queries: dict = {}          # name -> best record (ok wins)
         self.attempts: list = []         # every query record, file order
         self.progress = 0
+        self.metrics: list = []          # live-metrics rollups, file order
         self.end: dict | None = None
         self.torn = False
 
@@ -250,6 +293,11 @@ def load_ledger(path: str) -> LedgerData:
             else:
                 data.progress += 1
                 data.end = None          # heartbeat after end: resumed run
+        elif kind == "metrics":
+            # rollup activity is activity: like a heartbeat, a metrics
+            # record after an end record means a resumed run is in flight
+            data.end = None
+            data.metrics.append(rec)
         elif kind == "end":
             data.end = rec
     return data
@@ -388,6 +436,15 @@ class Ledger:
     def progress(self, **fields) -> dict:
         return self.write("progress", **fields)
 
+    def metrics(self, scope: str, **fields) -> dict:
+        """One schema-versioned live-metrics rollup record (the
+        :mod:`nds_tpu.obs.metrics` snapshot vocabulary): ``scope
+        "query"`` rides the drivers' rolling rollup per completed
+        query, ``scope "stream"`` the end-of-stream QPS / quantile /
+        queue-wait / timeout-shed aggregate."""
+        return self.write("metrics", scope=scope,
+                          metricsV=METRICS_VERSION, **fields)
+
     def close(self, status: str | None = None, **fields) -> None:
         """Write the terminal record (idempotent) and close the file.
         ``status=None`` closes without a terminal record (the caller
@@ -473,6 +530,14 @@ class Heartbeat:
                             if k not in ("beat",))
             print(f"# heartbeat {self.beats}: {desc}", file=self.out,
                   flush=True)
+        try:
+            # live-metrics snapshot on the heartbeat cadence: a cheap
+            # no-op unless NDS_TPU_METRICS_FILE is set (atomic
+            # write-temp-then-rename; registry reads only — sync-free
+            # like the rest of the beat)
+            _metrics_mod().export_live(extra=fields)
+        except Exception:
+            pass          # liveness must outlive exporter bugs too
         return fields
 
     def start(self) -> "Heartbeat":

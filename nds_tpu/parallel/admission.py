@@ -89,11 +89,27 @@ class DeviceAdmission:
         return False
 
     def acquire(self, poll_s: float = 0.05) -> float:
-        """Block until a slot frees; returns seconds spent queued."""
+        """Block until a slot frees; returns seconds spent queued.
+
+        The wait is an ``admission.acquire`` span and feeds the
+        ``admission.queue_wait_ms`` rolling histogram — slot
+        acquisition is an EXISTING host-side blocking point, so the
+        live-metrics feed here adds zero device syncs (span and
+        registry read host clocks only); surfaced as ``queueWaitMs``
+        in throughput per-query summaries and ledger records."""
+        # lazy: admission runs inside engine processes (jax already
+        # loaded); the bench parent never imports this module
+        from nds_tpu.obs import metrics as _metrics
+        from nds_tpu.obs import trace as _trace
         t0 = time.perf_counter()
-        while not self.try_acquire():
-            time.sleep(poll_s)
-        return time.perf_counter() - t0
+        with _trace.span("admission.acquire", slots=self.slots):
+            while not self.try_acquire():
+                time.sleep(poll_s)
+        queued = time.perf_counter() - t0
+        reg = _metrics.default()
+        reg.observe(_metrics.QUEUE_WAIT, queued * 1e3)
+        reg.gauge("admission.slots", self.slots)
+        return queued
 
     def release(self) -> None:
         if self._held is None:

@@ -199,9 +199,19 @@ def run_query_stream(input_prefix: str,
     admission = admission_from_env()
 
     from nds_tpu.obs import export as _obs_export
+    from nds_tpu.obs import metrics as _obs_metrics
     from nds_tpu.obs import trace as _obs_trace
     if trace_dir:
         os.makedirs(trace_dir, exist_ok=True)
+
+    # live-metrics registry (nds_tpu/obs/metrics.py): reset at stream
+    # start so the end-of-stream rollup record covers exactly this pass
+    # — each Throughput stream is its own process, so per-stream ==
+    # per-registry. Fed ONLY at the existing drain points below; the
+    # mid-run snapshot file (NDS_TPU_METRICS_FILE) is refreshed per
+    # query for tools/obs_live.py.
+    metrics_reg = _obs_metrics.default()
+    metrics_reg.reset()
 
     ledger = None
     ledger_path = ledger_path or os.environ.get("NDS_TPU_LEDGER")
@@ -216,6 +226,10 @@ def run_query_stream(input_prefix: str,
                         app=app_name, format=input_format)
 
     power_start = int(time.time())
+    # float twin of power_start: the reference time-log rows are
+    # whole-second, but the stream metrics record needs a wall that
+    # does not round a sub-second pass to zero (qps would vanish)
+    power_start_f = time.time()
     for query_name, q_content in query_dict.items():
         print(f"====== Run {query_name} ======")
         q_report = BenchReport(session)
@@ -305,8 +319,11 @@ def run_query_stream(input_prefix: str,
         q_report.summary["execMs"] = round(max(elapsed - compile_ms, 0.0), 1)
         if admission is not None:
             # time spent waiting for a device slot (admission control);
-            # NOT part of elapsed — the slot is held only while executing
+            # NOT part of elapsed — the slot is held only while executing.
+            # queueWaitMs is the live-metrics vocabulary for the same
+            # number (admissionQueuedMs kept for older readers).
             q_report.summary["admissionQueuedMs"] = round(queued_s * 1e3, 1)
+            q_report.summary["queueWaitMs"] = round(queued_s * 1e3, 1)
             q_report.summary["concurrentQueries"] = admission.slots
         scanned = getattr(session, "last_scanned", {})
         scan_bytes = sum(scanned.values())
@@ -352,6 +369,25 @@ def run_query_stream(input_prefix: str,
         # (test_warm.py): collectors globbing json_summary_folder filter
         # on phase != 'Warm'
         q_report.summary["phase"] = "Warm" if warm else "Power"
+        status = "ok" if q_report.is_success() else "error"
+        if status == "error" and any(
+                e.action == "timeout" for e in fault_events):
+            # the statement watchdog fired inside this query: the
+            # classified status is `timeout` (the run continued)
+            status = "timeout"
+        # live-metrics feeds — at THIS existing drain point only (the
+        # numbers above are already harvested; the registry reads no
+        # device state, so sync parity holds with metrics ON)
+        metrics_reg.inc("queries.total")
+        metrics_reg.inc(f"queries.{status}")
+        metrics_reg.observe(_obs_metrics.QUERY_WALL, elapsed)
+        metrics_reg.observe(_obs_metrics.SYNC_WAIT, sync_ms)
+        for s in q_report.summary.get("streamedScans", ()):
+            stall = s.get("prefetchStallMs", 0.0)
+            if stall > 0:
+                metrics_reg.observe(_obs_metrics.STALL, stall)
+        if fault_events:
+            metrics_reg.inc("faults.total", len(fault_events))
         if ledger is not None:
             # the ledger record: the durable, validated slice of the
             # summary (flushed now, so a kill loses at most the query in
@@ -359,22 +395,29 @@ def run_query_stream(input_prefix: str,
             # ledger writer
             rec = {"ms": elapsed, "phase": q_report.summary["phase"]}
             for k in ("hostSyncs", "syncWaitMs", "scanBytes", "scanGBps",
-                      "compileMs", "execMs", "streamedScans",
-                      "faultEvents"):
+                      "compileMs", "execMs", "queueWaitMs",
+                      "streamedScans", "faultEvents"):
                 if k in q_report.summary:
                     rec[k] = q_report.summary[k]
             if "trace" in q_report.summary:
                 rec["tracePhases"] = q_report.summary["trace"]
-            status = "ok" if q_report.is_success() else "error"
-            if status == "error" and any(
-                    e.action == "timeout" for e in fault_events):
-                # the statement watchdog fired inside this query: the
-                # classified status is `timeout` (the run continued)
-                status = "timeout"
             if status == "error" and q_report.summary["exceptions"]:
                 rec["error"] = str(q_report.summary["exceptions"][-1])[:300]
             ledger.query(query_name, status=status, **rec)
+            # the rolling rollup as of this query (queries/min, rolling
+            # wall quantiles, queue wait): the per-query metrics record
+            ledger.metrics(scope="query", query=query_name,
+                           **metrics_reg.query_rollup())
         queries_reports.append(q_report)
+        # mid-run live snapshot (atomic replace; no-op unless
+        # NDS_TPU_METRICS_FILE is set) — written while later queries
+        # are still executing, which is the whole point
+        _obs_metrics.export_live(
+            registry=metrics_reg,
+            extra={"driver": "power", "app": app_name,
+                   "query": query_name, "done": len(queries_reports),
+                   "total": len(query_dict),
+                   "phase": q_report.summary["phase"]})
         if json_summary_folder:
             if property_file:
                 summary_prefix = os.path.join(
@@ -397,6 +440,13 @@ def run_query_stream(input_prefix: str,
         (session.app_id, f"{phase} Test Time", power_elapse))
     execution_time_list.append((session.app_id, "Total Time", total_elapse))
     if ledger is not None:
+        # per-stream rollup (QPS, p50/p99 wall, queue-wait quantiles,
+        # timeout-shed) over the whole pass — the Throughput driver's
+        # stream-level metrics record, written before the terminal one
+        ledger.metrics(scope="stream", app=app_name,
+                       phase=phase,
+                       **metrics_reg.stream_rollup(
+                           time.time() - power_start_f))
         # terminal record: a ledger WITHOUT one is the signature of a
         # killed campaign (bench_compare reports it as incomplete)
         ledger.close("completed", queries=len(queries_reports),

@@ -120,6 +120,9 @@ def test_power_records_admission_fields(tmp_path, monkeypatch):
     doc = J.load(open(js[0]))
     assert doc.get("concurrentQueries") == 1
     assert "admissionQueuedMs" in doc
+    # the live-metrics vocabulary for the same number (metrics.py
+    # QUEUE_WAIT feed): summaries and ledger records carry queueWaitMs
+    assert doc.get("queueWaitMs") == doc["admissionQueuedMs"]
 
 
 def test_foreign_owned_slot_dir_fails_clearly(tmp_path, monkeypatch):

@@ -80,6 +80,28 @@ def test_unknown_version_rejected(tmp_path):
         L.load_ledger(str(p))
 
 
+def test_unknown_metrics_version_rejected(tmp_path):
+    """A ``metrics`` record whose metricsV is not the pinned rollup
+    schema must refuse loudly — quantile/bucket fields from a future
+    shape silently misread would poison cross-arm rollups. A valid-
+    version record loads into ``data.metrics`` (legacy ledgers simply
+    leave it empty)."""
+    p = tmp_path / "metrics.jsonl"
+    p.write_text(json.dumps({"v": 1, "kind": "metrics", "t": 0,
+                             "scope": "query", "metricsV": 99}) + "\n")
+    with pytest.raises(L.LedgerError, match="metrics record version 99"):
+        L.load_ledger(str(p))
+    p.write_text(json.dumps({"v": 1, "kind": "metrics", "t": 0,
+                             "scope": "query"}) + "\n")
+    with pytest.raises(L.LedgerError, match="metrics record version"):
+        L.load_ledger(str(p))            # missing metricsV is unknown too
+    p.write_text(json.dumps({"v": 1, "kind": "metrics", "t": 0,
+                             "scope": "stream", "qps": 2.5,
+                             "metricsV": L.METRICS_VERSION}) + "\n")
+    data = L.load_ledger(str(p))
+    assert len(data.metrics) == 1 and data.metrics[0]["qps"] == 2.5
+
+
 def test_malformed_v1_record_rejected(tmp_path):
     p = tmp_path / "bad.jsonl"
     p.write_text(json.dumps({"v": 1, "kind": "query", "t": 0}) + "\n")

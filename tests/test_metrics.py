@@ -1,0 +1,402 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Live-metrics plane (nds_tpu/obs/metrics.py): bucket math, rolling
+windows, merge algebra, thread determinism, the atomic snapshot
+exporter, the mid-run monitor, and the LIVE end-to-end drive — metrics
+records written into the ledger while queries still execute."""
+
+import importlib.util
+import itertools
+import json
+import os
+import threading
+
+from nds_tpu.obs import metrics as M
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_{name}_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fed(values, clock=lambda: 50.0, **kw):
+    r = M.Registry(clock=clock, **kw)
+    for v in values:
+        r.observe("x", v)
+    return r
+
+
+# ---------------------------------------------------------------------------
+# bucket math + quantiles
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_index_edges_and_clamps():
+    # exact edges land in their own bucket; epsilon past an edge moves up
+    for i in (0, 1, 7, 8, 35, 70, 71):
+        assert M.bucket_index(M.EDGES[i]) == i
+    assert M.bucket_index(M.EDGES[10] * 1.0001) == 11
+    # below-table, NaN and above-table all clamp instead of raising
+    assert M.bucket_index(0.0) == 0
+    assert M.bucket_index(-5.0) == 0
+    assert M.bucket_index(float("nan")) == 0
+    assert M.bucket_index(M.EDGES[-1] * 100) == len(M.EDGES) - 1
+    # monotone over a broad sweep
+    idxs = [M.bucket_index(10.0 ** (e / 10) / 10) for e in range(0, 80)]
+    assert idxs == sorted(idxs)
+
+
+def test_quantile_empty_and_single_sample():
+    assert M.quantile_from_buckets({}, 0.5) is None
+    r = _fed([42.0])
+    snap = r.snapshot()["hists"]["x"]
+    want = round(M.bucket_value(M.bucket_index(42.0)), 6)
+    # one sample: every quantile is that sample's bucket edge,
+    # cumulative and rolling alike
+    for key in ("p50", "p95", "p99"):
+        assert snap[key] == want
+        assert snap["rolling"][key] == want
+    assert snap["count"] == 1 and snap["min"] == snap["max"] == 42.0
+
+
+def test_empty_window_rollups():
+    r = M.Registry()
+    assert r.heartbeat_rollup() == {}
+    roll = r.query_rollup()
+    assert roll["queries"] == 0 and "qpm" not in roll
+    stream = r.stream_rollup(0.0)
+    assert stream["queries"] == 0 and "qps" not in stream
+    assert "wallP50Ms" not in stream
+
+
+# ---------------------------------------------------------------------------
+# rolling window rotation
+# ---------------------------------------------------------------------------
+
+
+def test_window_rotation_across_time_boundary():
+    t = {"now": 0.0}
+    r = M.Registry(window_s=12.0, slots=4, clock=lambda: t["now"])
+    r.observe("x", 100.0)            # epoch 0 (slot_s = 3s)
+    t["now"] = 5.0
+    r.observe("x", 900.0)            # epoch 1
+    assert r.snapshot()["hists"]["x"]["rolling"]["count"] == 2
+    # advance past epoch 0's window edge: the oldest sub-window ages out
+    # of the rollup WITHOUT any new feed (pure read-side filtering)
+    t["now"] = 13.0                  # epoch 4, floor = 1
+    snap = r.snapshot()["hists"]["x"]
+    assert snap["rolling"]["count"] == 1
+    assert snap["rolling"]["p99"] == \
+        round(M.bucket_value(M.bucket_index(900.0)), 6)
+    assert snap["count"] == 2        # cumulative never ages
+    # a new feed at epoch 4 recycles epoch 0's slot in place
+    r.observe("x", 100.0)
+    assert r.snapshot()["hists"]["x"]["rolling"]["count"] == 2
+    # far future: the whole window empties, heartbeat goes quiet again
+    t["now"] = 1000.0
+    assert r.snapshot()["hists"]["x"]["rolling"]["count"] == 0
+    assert r.heartbeat_rollup() == {}
+
+
+# ---------------------------------------------------------------------------
+# merge algebra
+# ---------------------------------------------------------------------------
+
+
+def test_merge_associative_and_order_independent():
+    snaps = [
+        _fed([1.0, 5.0, 9.0]).snapshot()["hists"]["x"],
+        _fed([100.0, 250.0]).snapshot()["hists"]["x"],
+        _fed([3000.0, 7000.0, 40.0, 0.5]).snapshot()["hists"]["x"],
+    ]
+    flat = M.merge_hist_snapshots(snaps)
+    for perm in itertools.permutations(snaps):
+        assert M.merge_hist_snapshots(list(perm)) == flat
+    # associativity: merging a merged snapshot with the remainder gives
+    # the same answer as the flat merge (cross-arm rollup shape)
+    paired = M.merge_hist_snapshots(
+        [M.merge_hist_snapshots(snaps[:2]), snaps[2]])
+    assert paired == flat
+    assert flat["count"] == 9
+    assert flat["min"] == 0.5 and flat["max"] == 7000.0
+    assert "ewma" not in flat        # feed-order construct: never merges
+
+
+# ---------------------------------------------------------------------------
+# thread determinism (the conc_audit_diff contention shape)
+# ---------------------------------------------------------------------------
+
+
+def test_quantiles_deterministic_under_contention():
+    n_threads, per_thread = 4, 200
+    feeds = [[float(t * per_thread + i + 1) for i in range(per_thread)]
+             for t in range(n_threads)]
+    reg = M.Registry(clock=lambda: 7.0)
+    barrier = threading.Barrier(n_threads)
+    errors = []
+
+    def worker(vals):
+        try:
+            barrier.wait(timeout=30)
+            for v in vals:
+                reg.observe("x", v)
+                reg.inc("n")
+        except Exception as exc:     # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(f,)) for f in feeds]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors and not any(t.is_alive() for t in threads)
+    serial = _fed([v for f in feeds for v in f], clock=lambda: 7.0)
+    got, want = reg.snapshot()["hists"]["x"], \
+        serial.snapshot()["hists"]["x"]
+    assert reg.counter("n") == n_threads * per_thread
+    assert got["count"] == want["count"]
+    assert got["buckets"] == want["buckets"]
+    for key in ("p50", "p95", "p99"):
+        assert got[key] == want[key]
+        assert got["rolling"][key] == want["rolling"][key]
+
+
+def test_threaded_quantile_probe_can_fail():
+    """--inject-drift discipline for the metrics lock: the
+    conc_audit_diff lock probe must PASS against the real registry lock
+    and FAIL against a no-op'd one — a probe that cannot fail proves
+    nothing about the threaded-quantile path."""
+    mod = _load_tool("conc_audit_diff")
+    reg = M.Registry()
+    seq = {"n": 0}
+
+    def observe():
+        # raw-dict reads (GIL-atomic): Registry.counter()/hist_count()
+        # would acquire the very lock the probe holds
+        h = reg._hists.get("probe.ms")
+        return (reg._counters.get("probe.count", 0),
+                0 if h is None else h.count)
+
+    def mutate():
+        seq["n"] += 1
+        reg.inc("probe.count")
+        reg.observe("probe.ms", float(seq["n"]))
+
+    assert mod.probe_lock("metrics", reg._lock, observe, mutate,
+                          hold_s=0.5) == []
+    reg._lock = mod._NoopLock()
+    problems = mod.probe_lock("metrics", reg._lock, observe, mutate,
+                              hold_s=0.5)
+    assert problems, "no-op'd registry lock was not caught"
+    assert any("no longer honors the lock" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# schema version pin + exporter
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_version_pinned_to_ledger():
+    from tools._ledger_load import ledger_mod
+    assert M.METRICS_VERSION == ledger_mod().METRICS_VERSION
+    assert _load_tool("_ledger_load").metrics_mod().METRICS_VERSION == \
+        M.METRICS_VERSION
+
+
+def test_export_live_atomic_and_env_gated(tmp_path, monkeypatch):
+    monkeypatch.delenv("NDS_TPU_METRICS_FILE", raising=False)
+    r = _fed([10.0, 20.0])
+    assert M.export_live(registry=r) is None   # unset env: cheap no-op
+    target = tmp_path / "arm" / "m-{pid}.json"
+    monkeypatch.setenv("NDS_TPU_METRICS_FILE", str(target))
+    p = M.export_live(registry=r, extra={"done": 1, "total": 3})
+    assert p == str(target).replace("{pid}", str(os.getpid()))
+    with open(p) as f:
+        doc = json.load(f)
+    assert doc["metricsV"] == M.METRICS_VERSION
+    assert doc["done"] == 1 and doc["total"] == 3 and doc["t"] > 0
+    assert doc["hists"]["x"]["count"] == 2
+    # replace, not append: a second export leaves ONE complete document
+    M.export_live(registry=r)
+    with open(p) as f:
+        assert json.load(f)["hists"]["x"]["count"] == 2
+    assert not [fn for fn in os.listdir(tmp_path / "arm")
+                if ".tmp." in fn], "tmp file leaked past the rename"
+
+
+def test_obs_live_renders_files_and_campaign_dirs(tmp_path, monkeypatch):
+    ol = _load_tool("obs_live")
+    assert any("no metrics snapshots" in ln
+               for ln in ol.report(str(tmp_path)))
+    for arm, walls in (("a1", [100.0, 200.0]), ("a2", [4000.0])):
+        r = M.Registry(clock=lambda: 50.0)
+        for w in walls:
+            r.observe(M.QUERY_WALL, w)
+        r.inc("queries.total", len(walls))
+        monkeypatch.setenv("NDS_TPU_METRICS_FILE",
+                           str(tmp_path / arm / "metrics.json"))
+        # obs_live reads the exporter's file format, not a test fake
+        M.export_live(registry=r, extra={"done": len(walls), "total": 9,
+                                         "query": "q88", "phase": "Power"})
+    monkeypatch.delenv("NDS_TPU_METRICS_FILE")
+    lines = ol.report(str(tmp_path))
+    body = "\n".join(lines)
+    assert "a1" in body and "a2" in body and "q88 [Power]" in body
+    assert any(ln.startswith("TOTAL") for ln in lines), \
+        "multi-source view must print the merged rollup row"
+    # single-file mode renders the same row
+    one = ol.report(str(tmp_path / "a1" / "metrics.json"))
+    assert any("2/9" in ln for ln in one)
+
+
+def test_heartbeat_progress_carries_rolling_rollup(tmp_path, capsys):
+    """The bench heartbeat's progress record and stderr liveness line
+    ride the rolling queries/min + EWMA query wall (the run_parent
+    status lambda merges heartbeat_rollup into the live fields)."""
+    import sys
+
+    from nds_tpu.obs.ledger import Heartbeat, Ledger, load_ledger
+    reg = M.Registry(clock=lambda: 30.0)
+    for w in (120.0, 80.0):
+        reg.observe(M.QUERY_WALL, w)
+    path = tmp_path / "hb.jsonl"
+    led = Ledger(str(path), driver="bench")
+    hb = Heartbeat(3600.0, ledger=led,
+                   status=lambda: {"done": 2, **reg.heartbeat_rollup()},
+                   out=sys.stderr)
+    fields = hb.beat()
+    led.close("completed")
+    assert fields["qpm"] == 2.0 and "ewmaWallMs" in fields
+    assert load_ledger(str(path)).progress == 1
+    with open(path) as f:
+        recs = [json.loads(ln) for ln in f
+                if json.loads(ln).get("kind") == "progress"]
+    assert recs and recs[-1]["qpm"] == 2.0
+    assert "ewmaWallMs" in recs[-1] and recs[-1]["done"] == 2
+    err = capsys.readouterr().err
+    assert "qpm=2.0" in err and "ewmaWallMs=" in err
+
+
+# ---------------------------------------------------------------------------
+# the LIVE end-to-end drive: snapshot + ledger records mid-run
+# ---------------------------------------------------------------------------
+
+
+def test_power_live_metrics_midrun(tmp_path, monkeypatch):
+    """Drive a REAL two-query Power stream and read the metrics plane
+    WHILE query 2 executes: the live snapshot file and the per-query
+    ``metrics`` ledger record written after query 1 must be complete and
+    renderable mid-run (obs_live), and the end-of-stream record must
+    carry the per-stream QPS / wall-quantile / queue-wait rollup (the
+    admission path runs under NDS_TPU_CONCURRENT_QUERIES=1)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from collections import OrderedDict
+
+    from nds_tpu import power
+    from nds_tpu.obs.ledger import load_ledger
+    from nds_tpu.schema import get_schemas
+    from nds_tpu.types import to_arrow as to_pa
+    fields = get_schemas(use_decimal=True)["item"]
+    monkeypatch.setattr(power, "get_schemas",
+                        lambda use_decimal: {"item": fields})
+    data = tmp_path / "data"
+    (data / "item").mkdir(parents=True)
+    cols = {f.name: pa.array([None, None], to_pa(f.type)) for f in fields}
+    cols["i_item_sk"] = pa.array([1, 2], to_pa(fields[0].type))
+    pq.write_table(pa.table(cols), data / "item" / "part-0.parquet")
+
+    live = tmp_path / "run" / "metrics.json"
+    monkeypatch.setenv("NDS_TPU_METRICS_FILE", str(live))
+    monkeypatch.setenv("NDS_TPU_CONCURRENT_QUERIES", "1")
+    monkeypatch.setenv("NDS_TPU_ADMISSION_DIR", str(tmp_path / "slots"))
+    gate = threading.Event()
+    q2_entered = threading.Event()
+    real_run = power.run_one_query
+
+    def gated(session, query, name, out_path, out_fmt):
+        if name == "q2":
+            q2_entered.set()
+            assert gate.wait(timeout=120), "main thread never released q2"
+        return real_run(session, query, name, out_path, out_fmt)
+
+    monkeypatch.setattr(power, "run_one_query", gated)
+    ledger_path = tmp_path / "ledger.jsonl"
+    failures = []
+
+    def drive():
+        try:
+            power.run_query_stream(
+                str(data), None,
+                OrderedDict(q1="select count(*) c from item",
+                            q2="select count(*) c from item"),
+                str(tmp_path / "t.csv"), ledger_path=str(ledger_path))
+        except Exception as exc:     # pragma: no cover - failure path
+            failures.append(exc)
+
+    t = threading.Thread(target=drive)
+    t.start()
+    try:
+        assert q2_entered.wait(timeout=300), \
+            f"stream never reached q2 (driver error: {failures})"
+        # --- query 2 is IN FLIGHT right now ---
+        with open(live) as f:
+            snap = json.load(f)
+        assert snap["done"] == 1 and snap["total"] == 2
+        assert snap["query"] == "q1" and snap["driver"] == "power"
+        assert snap["counters"]["queries.total"] == 1
+        assert snap["hists"][M.QUERY_WALL]["count"] == 1
+        rendered = "\n".join(
+            _load_tool("obs_live").report(str(live)))
+        assert "1/2" in rendered and "q1" in rendered
+        mid = load_ledger(str(ledger_path))
+        assert not mid.complete()    # genuinely mid-run
+        q1_rolls = [r for r in mid.metrics if r.get("scope") == "query"]
+        assert len(q1_rolls) == 1 and q1_rolls[0]["query"] == "q1"
+        assert q1_rolls[0]["queries"] == 1 and "qpm" in q1_rolls[0]
+    finally:
+        gate.set()
+        t.join(timeout=300)
+    assert not t.is_alive() and not failures, failures
+
+    led = load_ledger(str(ledger_path))
+    assert led.complete()
+    rolls = [r for r in led.metrics if r.get("scope") == "query"]
+    assert [r["query"] for r in rolls] == ["q1", "q2"]
+    streams = [r for r in led.metrics if r.get("scope") == "stream"]
+    assert len(streams) == 1
+    s = streams[0]
+    assert s["queries"] == 2 and s["okCount"] == 2
+    for key in ("qps", "wallP50Ms", "wallP99Ms", "wallMeanMs",
+                "queueWaitP50Ms", "queueWaitP99Ms"):
+        assert key in s, f"stream rollup missing {key}"
+    # per-query ledger records surface the admission wait as queueWaitMs
+    assert "queueWaitMs" in led.queries["q1"]
+    # the readers pick the records up (and the report stays append-only)
+    tr = _load_tool("trace_report")
+    lines = tr.metrics_report_lines(str(ledger_path))
+    assert any("stream" in ln and "qps=" in ln for ln in lines)
+    # reader parity: a legacy ledger (metrics records stripped) must
+    # produce EXACTLY the report minus the appended metrics section
+    legacy = tmp_path / "legacy.jsonl"
+    with open(ledger_path) as f, open(legacy, "w") as out:
+        for ln in f:
+            if json.loads(ln).get("kind") != "metrics":
+                out.write(ln)
+    with_recs = [ln.replace(str(ledger_path), "<L>")
+                 for ln in tr.report(str(ledger_path))]
+    without = [ln.replace(str(legacy), "<L>")
+               for ln in tr.report(str(legacy))]
+    assert with_recs[:len(without)] == without
+    assert with_recs[len(without):] == lines
+    bc = _load_tool("bench_compare")
+    rd = bc.load_round(str(ledger_path))
+    assert len(rd["metrics"]) == 3
+    assert bc.metrics_note(rd, "A")[0].startswith("# live metrics A")
+    legacy_rd = bc.load_round(str(legacy))
+    assert legacy_rd["metrics"] == [] and \
+        bc.metrics_note(legacy_rd, "A") == []

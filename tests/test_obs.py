@@ -40,7 +40,7 @@ def _span_names(records):
 # ---------------------------------------------------------------------------
 
 
-def test_tracing_adds_zero_syncs():
+def test_tracing_adds_zero_syncs(tmp_path, monkeypatch):
     """ops.sync_count() must be IDENTICAL for a traced vs untraced run of
     the A/B templates (chunked star join + streamed-fact filter): spans
     read host clocks and existing counters only, never the device. Both
@@ -49,13 +49,22 @@ def test_tracing_adds_zero_syncs():
     equally). The TRACED arm additionally runs under a live campaign
     heartbeat (nds_tpu/obs/ledger.py) whose status callable reads the
     sync counters — the heartbeat thread is part of the zero-added-sync
-    contract now that bench.py runs one for the whole campaign."""
+    contract now that bench.py runs one for the whole campaign — WITH
+    live metrics ON: the arm feeds the default registry per query and
+    the heartbeat exports the NDS_TPU_METRICS_FILE snapshot, so the
+    whole metrics plane (feed + rollup + atomic export) is inside the
+    parity pin."""
+    from nds_tpu.obs import metrics as obs_metrics
     from nds_tpu.obs.ledger import Heartbeat
     queries, make_session = _synccount_fixtures()
     ab = [q for q, _must in queries[:2]]
     assert obs_trace.on(), "tracing must be default-on"
+    live_file = str(tmp_path / "metrics.json")
+    monkeypatch.setenv("NDS_TPU_METRICS_FILE", live_file)
+    reg = obs_metrics.default()
+    reg.reset()
 
-    def run_arm():
+    def run_arm(feed):
         s = make_session(np.random.default_rng(42))
         obs_trace.drain_spans()
         out = []
@@ -64,21 +73,33 @@ def test_tracing_adds_zero_syncs():
             rows = s.sql(q).collect()
             out.append(E.sync_count() - before)
             assert rows
+            if feed:                  # the drivers' drain-point feeds
+                reg.inc("queries.total")
+                reg.inc("queries.ok")
+                reg.observe(obs_metrics.QUERY_WALL, 1.0 + len(out))
         return out
 
     hb = Heartbeat(0.01, ledger=None,
                    status=lambda: {"syncs": E.sync_count()}, out=None)
     with hb:
-        traced = run_arm()
+        traced = run_arm(feed=True)
     assert hb.beats > 0, "heartbeat must have fired during the arm"
+    assert os.path.exists(live_file), \
+        "heartbeat must have exported the live metrics snapshot"
+    with open(live_file) as f:
+        snap = json.load(f)
+    assert snap["metricsV"] == obs_metrics.METRICS_VERSION
+    assert snap["counters"]["queries.total"] >= 1
+    monkeypatch.delenv("NDS_TPU_METRICS_FILE")
     obs_trace.set_enabled(False)
     try:
-        untraced = run_arm()
+        untraced = run_arm(feed=False)
     finally:
         obs_trace.set_enabled(True)
     assert traced == untraced, \
-        f"tracing (+heartbeat) changed sync counts: " \
+        f"tracing (+heartbeat+metrics) changed sync counts: " \
         f"traced={traced} untraced={untraced}"
+    reg.reset()
     obs_trace.drain_spans()                     # leftovers from this test
 
 

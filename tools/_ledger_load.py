@@ -17,6 +17,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NAME = "_nds_ledger_stdlib"
 _CAMPAIGN_NAME = "_nds_campaign_stdlib"
+# shared with nds_tpu/obs/ledger.py's _metrics_mod(): both loaders must
+# resolve to ONE module object so the bench parent's feeds and the
+# heartbeat's live-file exporter see the same default registry
+_METRICS_NAME = "_nds_metrics_stdlib"
 
 
 def _load(name, relpath):
@@ -39,3 +43,9 @@ def campaign_mod():
     """The campaign-orchestration module (arm model, env fingerprint,
     manifest) — stdlib-only under the same discipline as the ledger."""
     return _load(_CAMPAIGN_NAME, ("nds_tpu", "obs", "campaign.py"))
+
+
+def metrics_mod():
+    """The live-metrics registry module (rolling rollups, snapshot
+    exporter) — stdlib-only under the same discipline as the ledger."""
+    return _load(_METRICS_NAME, ("nds_tpu", "obs", "metrics.py"))

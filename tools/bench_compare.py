@@ -127,6 +127,7 @@ def load_round(path):
     L = _ledger_mod()
     times, perf, evidence, meta, end, torn = {}, {}, {}, {}, None, False
     failed = {}
+    metrics = []
     if path.endswith(".json"):
         with open(path) as f:
             doc = json.load(f)
@@ -141,6 +142,10 @@ def load_round(path):
         torn = data.torn
         meta = data.meta
         end = data.end
+        # live-metrics rollup records (nds_tpu/obs/metrics.py) when the
+        # round carried them; [] on legacy ledgers — every consumer of
+        # this key must degrade to "no live metrics" silently
+        metrics = data.metrics
         for name, rec in data.queries.items():
             if rec["status"] != "ok" or "ms" not in rec:
                 continue
@@ -167,7 +172,7 @@ def load_round(path):
                 failed[name] = rec["status"]
     return {"path": path, "times": times, "perf": perf,
             "evidence": evidence, "meta": meta, "end": end, "torn": torn,
-            "failed": failed}
+            "failed": failed, "metrics": metrics}
 
 
 def compare(a, b):
@@ -239,6 +244,24 @@ def format_compare(cmp, a, b, top=15):
         lines.append(f"# ... {len(ranked) - top} more queries "
                      "(sorted by ratio, worst first)")
     return lines
+
+
+def metrics_note(r, label):
+    """One-line live-metrics summary per round when the ledger carried
+    ``metrics`` records (nds_tpu/obs/metrics.py rollups); [] on legacy
+    ledgers, so pre-metrics comparisons print byte-identically."""
+    streams = [m for m in r.get("metrics") or ()
+               if m.get("scope") == "stream"]
+    if not streams:
+        return []
+    s = streams[-1]
+    parts = [f"queries={s.get('queries')}"]
+    for key in ("qps", "wallP50Ms", "wallP99Ms", "queueWaitP99Ms",
+                "timeoutShed", "faults"):
+        if s.get(key) is not None:
+            parts.append(f"{key}={s[key]}")
+    return [f"# live metrics {label} ({round_label(r)}): "
+            + " ".join(parts)]
 
 
 def round_label(r, fallback=None):
@@ -903,6 +926,8 @@ def main(argv=None) -> int:
         b = inject_drift(b, args.threshold)
     cmp = compare(a, b)
     for ln in format_compare(cmp, a, b):
+        print(ln)
+    for ln in metrics_note(a, "A") + metrics_note(b, "B"):
         print(ln)
     violations = gate(cmp, threshold=args.threshold,
                       per_query_threshold=args.per_query_threshold,

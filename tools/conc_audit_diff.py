@@ -307,9 +307,13 @@ class _NoopLock:
 
 
 def _named_locks():
-    """name -> (module, lock attribute) of every contract lock the
-    probes exercise (and --inject-drift can no-op)."""
+    """name -> (holder, lock attribute) of every contract lock the
+    probes exercise (and --inject-drift can no-op). The holder is a
+    module for the module-level locks and the default Registry INSTANCE
+    for the live-metrics lock (its state is instance-scoped by the
+    conc_audit contract)."""
     from nds_tpu.engine import exprs, ops, stream
+    from nds_tpu.obs import metrics
     from nds_tpu.parallel import exchange
     from nds_tpu.sql import planner
     return {
@@ -318,6 +322,7 @@ def _named_locks():
         "mesh": (exchange, "_MESH_LOCK"),
         "identity": (ops, "_IDENTITY_LOCK"),
         "exprs": (exprs, "_DICT_MEMO_LOCK"),
+        "metrics": (metrics.default(), "_lock"),
     }
 
 
@@ -392,12 +397,30 @@ def _probe_specs():
     def exprs_mutate():
         exprs.literal(f"probe-value-{fresh()}", 4)
 
+    from nds_tpu.obs import metrics as _metrics
+    _metrics_reg = _metrics.default()
+
+    def metrics_observe():
+        # raw-dict reads, NOT Registry.counter()/hist_count(): those
+        # acquire the registry lock the probe is holding (deadlock);
+        # GIL-atomic dict gets match the other probes' len() reads
+        h = _metrics_reg._hists.get("probe.ms")
+        return (_metrics_reg._counters.get("probe.count", 0),
+                0 if h is None else h.count)
+
+    def metrics_mutate():
+        # the REAL public feed path: both must acquire the one
+        # registry lock to land
+        _metrics_reg.inc("probe.count")
+        _metrics_reg.observe("probe.ms", float(fresh()))
+
     return {
         "pipeline": (pipeline_observe, pipeline_mutate),
         "fuse": (fuse_observe, fuse_mutate),
         "mesh": (mesh_observe, mesh_mutate),
         "identity": (identity_observe, identity_mutate),
         "exprs": (exprs_observe, exprs_mutate),
+        "metrics": (metrics_observe, metrics_mutate),
     }
 
 

@@ -146,6 +146,14 @@ def git_changed_files():
 # record-or-reraise), and the env-fingerprint stamp it defines is what
 # every ledger record's provenance keys on — driver edits rerun the
 # corpus passes so that contract never drifts silently.
+# nds_tpu/obs/metrics.py (explicit for the same reason) is the
+# live-metrics registry every driver feeds from its drain points and
+# conc_audit walks whole-module under the instance-scoped-state
+# contract — registry edits rerun the corpus passes so the zero-
+# findings pin and the zero-added-sync parity never drift silently.
+# tools/obs_live.py (explicit: tools/ has no prefix entry) is the
+# mid-run monitor over the exported snapshots — driver-audit polices
+# its file handling and exception discipline like the other tools.
 _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/analysis/perf_audit.py",
                  "nds_tpu/engine", "nds_tpu/engine/kernels.py",
@@ -156,6 +164,8 @@ _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/io/chunk_store.py",
                  "nds_tpu/parallel/", "nds_tpu/obs/",
                  "nds_tpu/obs/campaign.py",
+                 "nds_tpu/obs/metrics.py",
+                 "tools/obs_live.py",
                  "nds_tpu/analysis/num_audit.py",
                  "nds_tpu/engine/exprs.py")
 

@@ -527,6 +527,52 @@ def render(agg, source, top=10):
     return lines
 
 
+def metrics_report_lines(path):
+    """Render a ledger's live-metrics records (``kind == "metrics"``,
+    nds_tpu/obs/metrics.py rollups) as an APPEND-ONLY section: legacy
+    ledgers without them return [] and the report is byte-identical to
+    the pre-metrics output (pinned by tests/test_obs.py)."""
+    sys.path.insert(0, REPO)
+    from tools._ledger_load import ledger_mod   # stdlib-only: no jax
+    recs = ledger_mod().load_ledger(path).metrics
+    if not recs:
+        return []
+
+    def fmt(rec, keys):
+        parts = []
+        for key, label in keys:
+            v = rec.get(key)
+            if v is not None:
+                parts.append(f"{label}={v}")
+        return " ".join(parts)
+
+    lines = ["", "# live metrics records (nds_tpu/obs/metrics.py "
+             "rollups carried in the ledger)"]
+    streams = [r for r in recs if r.get("scope") == "stream"]
+    queries = [r for r in recs if r.get("scope") == "query"]
+    for rec in streams:
+        lines.append("  stream  " + fmt(rec, (
+            ("app", "app"), ("phase", "phase"), ("queries", "queries"),
+            ("okCount", "ok"), ("errorCount", "err"),
+            ("timeoutShed", "timeoutShed"), ("faults", "faults"),
+            ("qps", "qps"), ("wallP50Ms", "wallP50Ms"),
+            ("wallP99Ms", "wallP99Ms"), ("wallMeanMs", "wallMeanMs"),
+            ("queueWaitP50Ms", "queueWaitP50Ms"),
+            ("queueWaitP99Ms", "queueWaitP99Ms"),
+            ("stallMs", "stallMs"))))
+    if queries:
+        last = queries[-1]
+        lines.append(f"  query rollups: {len(queries)} records; "
+                     "last " + fmt(last, (
+                         ("query", "query"), ("queries", "queries"),
+                         ("qpm", "qpm"), ("wallP50Ms", "wallP50Ms"),
+                         ("wallP99Ms", "wallP99Ms"),
+                         ("ewmaWallMs", "ewmaWallMs"),
+                         ("stallPct", "stallPct"),
+                         ("queueWaitP99Ms", "queueWaitP99Ms"))))
+    return lines
+
+
 def report(source, top=10):
     """Aggregate a --trace-dir (directory) or a campaign evidence ledger
     (file); returns the printable lines."""
@@ -540,6 +586,7 @@ def report(source, top=10):
         agg = collect_from_ledger(source)
         if agg is None:
             return [f"# no completed query records in ledger {source}"]
+        return render(agg, source, top=top) + metrics_report_lines(source)
     return render(agg, source, top=top)
 
 
