@@ -47,8 +47,19 @@ with no device in the loop:
   executable versions of the numeric-safety claims written as comments
   in ``io/columnar.py`` and ``engine/kernels.py``. Runtime half:
   ``tools/num_audit_diff.py``'s boundary-value differential.
+* :mod:`nds_tpu.analysis.param_audit` — literal-bindability prover over
+  the same decomposition: classifies every literal occurrence BINDABLE
+  (safe to ride as a jit operand of the one compiled per-chunk program
+  — recorded graph, chunk shapes, codec selection, partition counts,
+  residual keys and stream bounds all value-invariant) or FOLD-REQUIRED
+  with a machine-readable reason, derives per-template parameter
+  signatures with proven safe value domains, and exports the shared
+  rule (``conjunct_bind_slots`` / ``skeleton_conjunct_key``) that
+  ``engine/stream.py`` uses to canonicalize the pipeline-cache key so
+  K parameter vectors share one compile. Runtime half:
+  ``tools/param_audit_diff.py``'s one-compile-many-params differential.
 
-``tools/lint.py`` runs all eight and gates on new findings against the
+``tools/lint.py`` runs all nine and gates on new findings against the
 checked-in :data:`BASELINE_PATH` (accepted pre-existing findings); code-lint
 findings are suppressible in-source with ``# nds-lint: ignore[rule]``.
 """

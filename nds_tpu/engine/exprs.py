@@ -33,6 +33,64 @@ _DICT_MEMO_LOCK = threading.Lock()
 # literals / lifting
 # ---------------------------------------------------------------------------
 
+# Parameter-binding context: inside a compiled replay, audited-bindable
+# WHERE literals are served from jit operands instead of trace constants
+# (one compile, many parameter vectors — see analysis/param_audit).  The
+# map is keyed by id(Literal AST node): stream.StreamPipeline keeps the
+# build statement's slot nodes alive for the life of the cached program.
+_PARAM_TL = threading.local()
+
+
+class param_binding:
+    """Context manager installing ``{id(node): (typetag, operand)}`` for
+    the planner's Literal arm to consult (thread-local, nestable)."""
+
+    def __init__(self, bindings: dict):
+        self._bindings = bindings
+
+    def __enter__(self):
+        prev = getattr(_PARAM_TL, "bindings", None)
+        self._prev = prev
+        _PARAM_TL.bindings = self._bindings
+        return self
+
+    def __exit__(self, *exc):
+        _PARAM_TL.bindings = self._prev
+        return False
+
+
+def param_bindings_active() -> bool:
+    """True inside a compiled replay that carries bound-literal operands.
+    The planner's expression-fusion caches must stand down then: a fused
+    program is keyed by ``expr_key`` (which serializes literal VALUES)
+    and traced once — serving it inside the pipeline trace would inline
+    the RECORD phase's baked constants past the binding. Inside the
+    pipeline's jit the fused dispatch is inlined anyway, so evaluating
+    eagerly there costs nothing at drive time."""
+    return bool(getattr(_PARAM_TL, "bindings", None))
+
+
+def bound_literal(e, n: int) -> Column | None:
+    """The operand-backed Column for a bound Literal node, or None when
+    no binding is active for it (the planner then bakes the value as a
+    trace constant, today's behaviour)."""
+    bindings = getattr(_PARAM_TL, "bindings", None)
+    if not bindings:
+        return None
+    hit = bindings.get(id(e))
+    if hit is None:
+        return None
+    tag, arr = hit
+    if tag == "i64":
+        return Column("i64", jnp.broadcast_to(
+            jnp.asarray(arr, dtype=jnp.int64), (n,)))
+    if tag == "f64":
+        return Column("f64", jnp.broadcast_to(
+            jnp.asarray(arr, dtype=jnp.float64), (n,)))
+    s = int(tag.split(":")[1])           # "dec:<scale>" pre-scaled int
+    return Column(f"dec(38,{s})", jnp.broadcast_to(
+        jnp.asarray(arr, dtype=jnp.int64), (n,)))
+
 
 def literal(value, n: int) -> Column:
     """Python literal -> broadcast Column of length n."""

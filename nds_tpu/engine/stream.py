@@ -153,6 +153,7 @@ import weakref
 import jax
 import jax.numpy as jnp
 
+from nds_tpu.engine import exprs as _X
 from nds_tpu.engine import faults as _F
 from nds_tpu.engine import kernels as _K
 from nds_tpu.engine import ops as E
@@ -160,6 +161,7 @@ from nds_tpu.engine import prefetch as _PF
 from nds_tpu.engine.column import Column, slice_col_prefix
 from nds_tpu.engine.table import DeviceTable
 from nds_tpu.listener import record_stream_event
+from nds_tpu.obs import metrics as _metrics
 from nds_tpu.obs import trace as _obs
 
 log = logging.getLogger(__name__)
@@ -465,7 +467,7 @@ class StreamPipeline:
                  residuals=(), resid_specs=(), build_slots=(),
                  name_catalog=None, n_shards=1, mesh=None,
                  mesh_axis="shard", exchange=False, cap_ex=0,
-                 scan_spec=None):
+                 scan_spec=None, param_nodes=(), param_tags=()):
         self.chunk_spec = chunk_spec      # ((aliased name, kind, dict), ...)
         self.chunk_cap = chunk_cap
         self.part_specs = part_specs      # specs of non-streamed parts
@@ -509,6 +511,16 @@ class StreamPipeline:
         # the chunk-invariant predicate/codec spec extracted at record
         # time (engine/exprs.lower_scan_spec); None = XLA chain only
         self.scan_spec = scan_spec
+        # parameter binding (DESIGN.md "Parameterized plans"): the build
+        # statement's audited-bindable Literal AST nodes, kept alive here
+        # so their id()s stay stable for the compiled program's lifetime.
+        # At dispatch, each execution's literal VALUES ride as extra jit
+        # operands appended after ``operands``; the traced body peels
+        # them off and installs the exprs.param_binding the planner's
+        # Literal arm consults. Slot ORDER is the cache key's slot
+        # signature order — a hit is guaranteed to agree.
+        self.param_nodes = tuple(param_nodes)
+        self.param_tags = tuple(param_tags)
         self.jitted = None
         self._pid_jit = None
         self._scan_jit = None
@@ -546,6 +558,8 @@ class StreamPipeline:
         resid_specs = self.resid_specs
         n_builds = len(self.build_slots)
         name_cat = self.name_catalog
+        param_nodes, param_tags = self.param_nodes, self.param_tags
+        n_params = len(param_nodes)
 
         body_plen = self.body_plen
 
@@ -599,14 +613,27 @@ class StreamPipeline:
                                           resid_flat):
                 pl._subquery_residuals[rkey] = (
                     None, _rebuild_part(rspec, rflat))
-            with E.replaying(rec_log, ops_flat):
-                with E.stream_bounds() as sb:
-                    with E.outer_match_collector() as omc:
-                        out = pl._join_parts(sub, list(join_preds),
-                                             list(where_conjuncts),
-                                             list(base_sources))
-                    flags = list(sb.flags)
-                    matched = list(omc.masks)
+            # audited-bindable literal operands ride at the END of
+            # ops_flat (appended per execution by run); peel them off so
+            # the replay log sees exactly its recorded operand count, and
+            # install the binding the planner's Literal arm consults —
+            # the bound conjuncts then trace against operand Columns
+            # instead of baking this execution's values as constants
+            bindings = {}
+            if n_params:
+                params = ops_flat[-n_params:]
+                ops_flat = ops_flat[:-n_params]
+                bindings = {id(nd): (tag, v) for nd, tag, v
+                            in zip(param_nodes, param_tags, params)}
+            with _X.param_binding(bindings):
+                with E.replaying(rec_log, ops_flat):
+                    with E.stream_bounds() as sb:
+                        with E.outer_match_collector() as omc:
+                            out = pl._join_parts(sub, list(join_preds),
+                                                 list(where_conjuncts),
+                                                 list(base_sources))
+                        flags = list(sb.flags)
+                        matched = list(omc.masks)
             if list(out.column_names) != list(names):
                 raise E.ReplayMismatch(
                     "streamed trace produced a different output schema "
@@ -1029,19 +1056,24 @@ class StreamPipeline:
             out.append((miss, jnp.sum(miss)))
         return out
 
-    def run(self, chunks, first_chunk, parts_flat, resid_flat=()):
+    def run(self, chunks, first_chunk, parts_flat, resid_flat=(),
+            params=()):
         """Drive every chunk through the compiled program; returns
         ``(survivor DeviceTable | None-on-overflow, n_chunks, evidence)``
         (overflow => the caller re-runs eagerly). ``evidence`` carries the
         partition counts of a partitioned run and the outer-extras
         masks/counts of deferred outer-build joins. ``chunks`` continues
-        AFTER ``first_chunk`` (already converted)."""
+        AFTER ``first_chunk`` (already converted). ``params`` — THIS
+        execution's bound-literal operand values, slot order (passed
+        per call, never stored: concurrent cache-hit executions share
+        the pipeline object)."""
         if self.n_shards > 1:
             return _run_sharded(self, chunks, first_chunk, parts_flat,
-                                resid_flat)
+                                resid_flat, params)
         if self.n_partitions > 1:
             return self._run_partitioned(chunks, first_chunk, parts_flat,
-                                         resid_flat)
+                                         resid_flat, params)
+        ops = self.operands + tuple(params)
         acc = self.init_acc()
         # bounded prefetch ring (engine/prefetch.py): a worker thread
         # runs the host slice + encode + async upload for upcoming
@@ -1086,7 +1118,7 @@ class StreamPipeline:
                     acc = self._first_kern(
                         "kern_chunk",
                         lambda a=acc, f=flat, nd=n_dev, lv=live:
-                        self.jitted(f, nd, parts_flat, self.operands, a,
+                        self.jitted(f, nd, parts_flat, ops, a,
                                     resid_flat, live=lv))
                 self.traced_once = True
                 n_chunks += 1
@@ -1136,7 +1168,7 @@ class StreamPipeline:
         return DeviceTable(cols, total, plen=min(cap, self.acc_cap))
 
     def _run_partitioned(self, chunks, first_chunk, parts_flat,
-                         resid_flat=()):
+                         resid_flat=(), params=()):
         """Grace-style drive: each chunk uploads ONCE, the partition pass
         assigns row partition ids (histogram stays device-resident), and
         the one compiled program dispatches once per partition into that
@@ -1149,6 +1181,7 @@ class StreamPipeline:
         together first — a build row matched by ANY partition of ANY
         chunk is matched)."""
         P = self.n_partitions
+        ops = self.operands + tuple(params)
         accs = [self.init_acc() for _ in range(P)]
         hist = jnp.zeros(P, dtype=jnp.int64)
         pid_consts = [jnp.asarray(p, dtype=jnp.int32) for p in range(P)]
@@ -1183,7 +1216,7 @@ class StreamPipeline:
                             "kern_chunk",
                             lambda a=accs[p], f=flat, nd=n_dev, pv=pids,
                             pc=pid_consts[p], lv=mask:
-                            self.jitted(f, nd, parts_flat, self.operands,
+                            self.jitted(f, nd, parts_flat, ops,
                                         a, resid_flat, pids=pv,
                                         part_id=pc, live=lv))
                     self.traced_once = True
@@ -1236,7 +1269,8 @@ class StreamPipeline:
         return out, n_chunks, evidence
 
 
-def _run_sharded(pipe, chunks, first_chunk, parts_flat, resid_flat=()):
+def _run_sharded(pipe, chunks, first_chunk, parts_flat, resid_flat=(),
+                 params=()):
     """Mesh-sharded drive (any partition count): every chunk uploads
     ROW-SHARDED over the local-device mesh, dimension parts / replay
     operands / residuals ride replicated, and the one shard_map'd
@@ -1261,7 +1295,8 @@ def _run_sharded(pipe, chunks, first_chunk, parts_flat, resid_flat=()):
 
     parts_rep = tuple(tuple(put_rep(x) for x in p) for p in parts_flat)
     resid_rep = tuple(tuple(put_rep(x) for x in p) for p in resid_flat)
-    ops_rep = tuple(put_rep(x) for x in pipe.operands)
+    # bound-literal operands ride replicated like the replay operands
+    ops_rep = tuple(put_rep(x) for x in pipe.operands + tuple(params))
     accs = [pipe.init_acc() for _ in range(P)]
     hist = jax.device_put(jnp.zeros((S, P), dtype=jnp.int64), row)
     ex_ovf = jax.device_put(jnp.zeros((S,), dtype=bool), row)
@@ -1450,16 +1485,93 @@ def _dicts_equal(a, b) -> bool:
     return a is b or np.array_equal(a, b)
 
 
+def _param_bind_active() -> bool:
+    """Parameter binding is ON by default (``NDS_TPU_PARAM_BIND=0`` is
+    the escape hatch) but always OFF under the fused-kernel arm: the
+    Pallas scan specs bake comparison thresholds into their lowered
+    predicate entries on host, so a bound operand could never reach
+    them — rather than splitting conjuncts between arms, the kernel arm
+    keeps today's bake-everything behaviour (both modes are cache-key
+    members, so the arms never share an entry)."""
+    return os.environ.get("NDS_TPU_PARAM_BIND", "1") != "0" \
+        and not _K.scan_kernels_active()
+
+
+def _param_slots(planner, parts, keep, where_conjuncts, chunk_spec):
+    """Audited-bindable slots of THIS statement's WHERE conjuncts:
+    ``((conjunct index, field path, typetag, Literal node), ...)`` in
+    deterministic walk order. Ownership mirrors ``_build_pipeline``'s
+    ``owned()`` exactly — ``planner._expr_tables`` owners == {keep} —
+    so a slot can only come from a conjunct the planner evaluates
+    purely over chunk columns in-trace. The classification rule itself
+    (comparand positions, type tags, safe domains) is
+    ``analysis/param_audit.conjunct_bind_slots`` — the ONE rule the
+    static auditor proves corpus-wide and the diff harness locks."""
+    from nds_tpu.analysis.param_audit import (conjunct_bind_slots,
+                                              drift_active)
+    names_keep = {nm for (nm, _k, _dv, _en) in chunk_spec}
+    sub_cols = [names_keep if i == keep else set(p.column_names)
+                for i, p in enumerate(parts)]
+    all_cols = set().union(*sub_cols)
+    drift = drift_active()
+    slots = []
+    for ci, c in enumerate(where_conjuncts):
+        has_sub = planner._has_subquery(c)
+        owned = False
+        if not has_sub:
+            tabs = planner._expr_tables(c, all_cols)
+            owners = set()
+            for p_i, pc in enumerate(sub_cols):
+                for t in tabs:
+                    if any(cc.startswith(t + ".") for cc in pc):
+                        owners.add(p_i)
+            owned = owners == {keep}
+        for (path, node, tag) in conjunct_bind_slots(
+                c, owned, has_sub, drift=drift):
+            slots.append((ci, path, tag, node))
+    return tuple(slots)
+
+
+def _param_operands(bind_slots):
+    """This execution's bound-literal operand values, slot order —
+    device-typed scalars (a Python int would re-trace as a weak type)."""
+    from nds_tpu.analysis.param_audit import slot_param_value
+    out = []
+    for (_ci, _path, tag, node) in bind_slots:
+        v = slot_param_value(node.value, tag)
+        out.append(jnp.asarray(
+            v, dtype=jnp.float64 if tag == "f64" else jnp.int64))
+    return tuple(out)
+
+
 def _cache_key(alias, keep, join_preds, where_conjuncts, sources,
-               part_infos, chunk_spec, chunk_cap, stream_rows, outer_meta):
+               part_infos, chunk_spec, chunk_cap, stream_rows, outer_meta,
+               bind_slots=()):
     from nds_tpu.analysis.mem_audit import (stream_partitions_env,
                                             stream_shards_env,
                                             stream_skew_factor)
+    from nds_tpu.analysis.param_audit import skeleton_conjunct_key
     from nds_tpu.engine.column import enc_key
     from nds_tpu.sql.parser import expr_key
+    # audited-bindable conjuncts key on their template SKELETON (literal
+    # values become typed placeholders): K parameter vectors of one
+    # template collapse onto one entry, one compile. The slot signature
+    # rides alongside — two statements only share an entry when their
+    # bindable slots line up exactly (count, position, operand type).
+    by_conj = {}
+    for (ci, path, tag, node) in bind_slots:
+        by_conj.setdefault(ci, []).append((path, node, tag))
     return (
         tuple(expr_key(c) for c in join_preds),
-        tuple(expr_key(c) for c in where_conjuncts),
+        tuple(skeleton_conjunct_key(c, by_conj[i]) if i in by_conj
+              else expr_key(c)
+              for i, c in enumerate(where_conjuncts)),
+        tuple((ci, path, tag) for (ci, path, tag, _n) in bind_slots),
+        # bind/drift mode are key members read AT KEY TIME (conc-audit
+        # cache-key completeness): flipping either can never serve a
+        # pipeline compiled under the other mode
+        os.environ.get("NDS_TPU_PARAM_BIND", "1"),
+        os.environ.get("NDS_TPU_PARAM_DRIFT"),
         keep, tuple(sources), alias.lower(), chunk_cap,
         tuple((n, k, enc_key(en)) for (n, k, _dv, en) in chunk_spec),
         tuple(((tuple((cn, ck, hv, enc_key(en))
@@ -1553,6 +1665,7 @@ def _resolve_residuals(planner, key, pipe):
             if _PIPELINE_CACHE.get(key) is pipe:
                 _PIPELINE_CACHE.pop(key, None)
                 _PIPELINE_BUILD_COUNTS.pop(key, None)
+        _metrics.default().inc(_metrics.PIPE_EVICT)
         return None, ()
     return pipe, got
 
@@ -1580,6 +1693,7 @@ def _cache_hit(key, chunk_spec, part_infos):
             if _PIPELINE_CACHE.get(key) is pipe:
                 _PIPELINE_CACHE.pop(key, None)
                 _PIPELINE_BUILD_COUNTS.pop(key, None)
+        _metrics.default().inc(_metrics.PIPE_EVICT)
         return None
     return pipe
 
@@ -1638,14 +1752,18 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
 
     key = None
     hit0 = None
+    bind_slots = ()
     pipe, resid_infos = None, ()
     try:
+        if _param_bind_active():
+            bind_slots = _param_slots(planner, parts, keep,
+                                      where_conjuncts, chunk_spec)
         key = _cache_key(alias, keep, join_preds, where_conjuncts,
                          masked_sources, part_infos, chunk_spec, chunk_cap,
-                         chunked.nrows, outer_meta)
+                         chunked.nrows, outer_meta, bind_slots)
         hit0 = _cache_hit(key, chunk_spec, part_infos)
     except Exception:
-        hit0 = None                      # unkeyable statement: no cache
+        hit0, key = None, None           # unkeyable statement: no cache
     # residual replan runs OUTSIDE the unkeyable guard: its failures are
     # real execution errors, not cache-key problems
     if hit0 is not None:
@@ -1681,7 +1799,11 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
             else:
                 pending.wait(timeout=300.0)
     # label the planner's enclosing "stream" span with the cache outcome
+    # and feed the metrics plane (the cache-efficacy evidence the
+    # parameterized plan bank is judged by: obs_live columns, rollups)
     _obs.annotate(pipelineCache="hit" if pipe is not None else "miss")
+    _metrics.default().inc(_metrics.PIPE_HIT if pipe is not None
+                           else _metrics.PIPE_MISS)
 
     degrade_reason = None
     if pipe is None:
@@ -1690,7 +1812,8 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
                 pipe, resid_infos = _build_pipeline(
                     planner, parts, keep, alias, join_preds,
                     where_conjuncts, masked_sources, part_infos,
-                    outer_meta, first, chunk_spec, chunk_cap, n_chunks)
+                    outer_meta, first, chunk_spec, chunk_cap, n_chunks,
+                    bind_slots=bind_slots)
             except _F.FaultInjected as exc:
                 # pipeline-compile seam (degradable): the designed
                 # recovery is the compiled->eager ladder step — record
@@ -1704,6 +1827,7 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
                 degrade_reason = (f"fault: {exc.seam} "
                                   "(degraded compiled->eager)")
             if pipe is not None and key is not None:
+                n_evicted = 0
                 with _PIPELINE_LOCK:
                     _PIPELINE_BUILD_COUNTS[key] = \
                         _PIPELINE_BUILD_COUNTS.get(key, 0) + 1
@@ -1714,7 +1838,10 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
                         # lived serving process must not grow one
                         # counter key per shape it ever saw
                         _PIPELINE_BUILD_COUNTS.pop(evicted, None)
+                        n_evicted += 1
                     _PIPELINE_CACHE[key] = pipe
+                if n_evicted:            # count OFF-lock, like the feeds
+                    _metrics.default().inc(_metrics.PIPE_EVICT, n_evicted)
         finally:
             if claim is not None:
                 with _PIPELINE_LOCK:
@@ -1724,12 +1851,16 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
             return None, degrade_reason or "not chunk-invariant"
 
     resid_flat = tuple(tuple(flat) for (_spec, flat) in resid_infos)
+    # THIS statement's literal values for the pipe's bound slots (a hit
+    # is key-guaranteed to agree on slot count/order/types — only the
+    # values differ, and they ride as jit operands, not trace constants)
+    params = _param_operands(bind_slots) if pipe.param_nodes else ()
     snapshot = list(E._pending_counts())
     checks_snapshot = [c for c, _f in
                        (getattr(E._sync_tls, "checks", None) or [])]
     try:
         out, ran, evidence = pipe.run(chunk_iter, first, parts_flat,
-                                      resid_flat)
+                                      resid_flat, params)
         # tracing the first call replays planner code that registers
         # DeviceCounts/deferred checks holding TRACER values; they belong
         # to the trace, not this execution — drop them before any
@@ -1752,6 +1883,7 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
         with _PIPELINE_LOCK:
             _PIPELINE_CACHE.pop(key, None)
             _PIPELINE_BUILD_COUNTS.pop(key, None)
+        _metrics.default().inc(_metrics.PIPE_EVICT)
         _F.record_fault_event(exc.seam, "degrade",
                               detail=f"drive fault -> eager rerun: {exc}")
         log.info("streamed pipeline hit fault seam %s; re-running %s "
@@ -1771,6 +1903,7 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
         with _PIPELINE_LOCK:
             _PIPELINE_CACHE.pop(key, None)
             _PIPELINE_BUILD_COUNTS.pop(key, None)
+        _metrics.default().inc(_metrics.PIPE_EVICT)
         if _strict() and not isinstance(exc, (E.StreamSyncError,
                                               E.ReplayMismatch)):
             raise
@@ -1836,7 +1969,8 @@ def stream_execute(planner, parts, keep, join_preds, where_conjuncts,
 
 def _build_pipeline(planner, parts, keep, alias, join_preds,
                     where_conjuncts, masked_sources, part_infos,
-                    outer_meta, first, chunk_spec, chunk_cap, n_chunks):
+                    outer_meta, first, chunk_spec, chunk_cap, n_chunks,
+                    bind_slots=()):
     """RECORD the per-chunk join graph on the first padded chunk and
     compile the chunk-invariant program; ``(None, None)`` when not
     streamable. Returns ``(pipe, resid_infos)`` — the flattened subquery
@@ -1881,8 +2015,11 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
     # pre/post conjunct split must not be disturbed).
     scan_spec = None
     where_kept = list(where_conjuncts)
-    if _K.scan_kernels_active() and not any(m is not None
-                                            for m in outer_meta):
+    # bind_slots nonempty means the cache key promised operand-backed
+    # conjuncts (computed under kernels-off); never lower them into a
+    # host-baked Pallas spec even if the kernel arm flipped since
+    if _K.scan_kernels_active() and not bind_slots \
+            and not any(m is not None for m in outer_meta):
         from nds_tpu.engine.exprs import lower_scan_spec
         cols_meta = []
         for pos, cname in enumerate(first.column_names):
@@ -2062,6 +2199,11 @@ def _build_pipeline(planner, parts, keep, alias, join_preds,
         resid_specs=tuple(spec for (spec, _flat) in resid_infos),
         build_slots=build_slots, name_catalog=name_cat,
         n_shards=n_shards, mesh=mesh, mesh_axis=axis_name or "shard",
-        exchange=exchange, cap_ex=cap_ex, scan_spec=scan_spec)
+        exchange=exchange, cap_ex=cap_ex, scan_spec=scan_spec,
+        # bound slots reference where_conjuncts Literal nodes; with the
+        # kernel arm off (binding's precondition) where_kept IS
+        # where_conjuncts, so the traced replay sees those same nodes
+        param_nodes=tuple(nd for (_ci, _p, _t, nd) in bind_slots),
+        param_tags=tuple(t for (_ci, _p, t, _nd) in bind_slots))
     return (pipe.compile(join_preds, where_kept, masked_sources),
             resid_infos)

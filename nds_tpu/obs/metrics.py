@@ -66,6 +66,13 @@ QUERY_WALL = "query.wall_ms"
 QUEUE_WAIT = "admission.queue_wait_ms"
 STALL = "prefetch.stall_ms"
 SYNC_WAIT = "query.sync_wait_ms"
+# pipeline-cache efficacy (engine/stream.py feeds these at the cache
+# decision + every eviction): the evidence the parameterized plan bank
+# is judged by — a throughput stream of K literal permutations per
+# template should show K-1 hits per shape, not K misses
+PIPE_HIT = "pipeline.cache.hit"
+PIPE_MISS = "pipeline.cache.miss"
+PIPE_EVICT = "pipeline.cache.evict"
 
 # the ONE bucket edge table every histogram shares: geometric,
 # 8 buckets/decade (~33% resolution), 1e-1 .. 10^7.875 (~21 h in ms).
@@ -326,6 +333,14 @@ class Registry:
             faults = self._counters.get("faults.total", 0)
             if faults:
                 out["faults"] = faults
+            # pipeline-cache efficacy (appear only once streaming ran:
+            # a dim-only run keeps the record clean)
+            for field, name in (("pipeHit", PIPE_HIT),
+                                ("pipeMiss", PIPE_MISS),
+                                ("pipeEvict", PIPE_EVICT)):
+                n = self._counters.get(name, 0)
+                if n:
+                    out[field] = n
             wall = self._rolling_stats(QUERY_WALL, now)
             if wall is not None:
                 count, total, buckets, ewma = wall
@@ -377,6 +392,12 @@ class Registry:
             if wall_s > 0:
                 out["qps"] = round(out["queries"] / wall_s, 4)
                 out["qpm"] = round(out["qps"] * 60.0, 2)
+            for field, name in (("pipeHit", PIPE_HIT),
+                                ("pipeMiss", PIPE_MISS),
+                                ("pipeEvict", PIPE_EVICT)):
+                n = self._counters.get(name, 0)
+                if n:
+                    out[field] = n
             h = self._hists.get(QUERY_WALL)
             if h is not None and h.count:
                 out["wallP50Ms"] = quantile_from_buckets(h.buckets, 0.5)

@@ -1106,7 +1106,13 @@ class Planner:
         set permanently falls back to eager evaluation."""
         if not conjuncts:
             return jnp.ones(table.plen, dtype=bool)
+        # under an active param binding (compiled replay with bound-
+        # literal operands) fusion must stand down: fused programs bake
+        # literal values at their own trace time, which would bypass the
+        # binding — and inside the pipeline's jit the fused call is
+        # inlined anyway, so eager evaluation there is free
         if os.environ.get("NDS_TPU_NO_EXPR_FUSE") or \
+                X.param_bindings_active() or \
                 any(self._has_subquery(c) for c in conjuncts):
             return self._conjunct_mask_eager(table, conjuncts)
         plen = table.plen
@@ -2221,6 +2227,11 @@ class Planner:
                 return hit
 
         if isinstance(e, A.Literal):
+            # audited-bindable slots replay from jit operands (one
+            # compile, many parameter vectors); everything else bakes.
+            bound = X.bound_literal(e, n)
+            if bound is not None:
+                return bound
             return X.literal(e.value, n)
         if isinstance(e, A.DateLiteral):
             days = X.parse_date_literal(e.text)

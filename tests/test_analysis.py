@@ -13,6 +13,8 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TEMPLATES = os.path.join(REPO, "nds_tpu", "queries", "templates")
 
@@ -1597,16 +1599,33 @@ def _run_lint(*argv):
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
 
 
-def test_lint_cli_gate(tmp_path):
-    """The shipped baseline gates clean; a seeded bad template fails.
-    Rides the same subprocess run to check --num-report plumbing: the
-    proof table on stdout and the ``num_report`` field in --json."""
-    r = _run_lint("--json", str(tmp_path / "report.json"), "--num-report")
+@pytest.fixture(scope="module")
+def lint_combined(tmp_path_factory):
+    """ONE clean-tree lint subprocess shared by every report-plumbing
+    test below. The nine passes run identically whichever report flags
+    ride, so the per-flag CLI tests differ only in FORMATTING — a single
+    combined ``--format json`` run (machine document on stdout, every
+    human table on stderr, ``--json`` file alongside) covers them all
+    for the price of one subprocess instead of eight on a one-core
+    runner. Seeded-corpus and exit-code-contract runs stay per-test."""
+    json_path = str(tmp_path_factory.mktemp("lint") / "report.json")
+    r = _run_lint("--format", "json", "--json", json_path,
+                  "--stream-report", "--mem-report", "--perf-report",
+                  "--num-report", "--param-report")
     assert r.returncode == 0, r.stdout + r.stderr
+    return r, json.loads(r.stdout), json_path
+
+
+def test_lint_cli_gate(tmp_path, lint_combined):
+    """The shipped baseline gates clean; a seeded bad template fails.
+    Rides the shared subprocess to check --num-report plumbing (the
+    proof table, on stderr under --format json) and the ``num_report``
+    field in the --json file document."""
+    r, _doc, json_path = lint_combined
     assert "# num-audit: per-statement value-range/precision proofs" \
-        in r.stdout
-    assert "proven-safe compiled-stream" in r.stdout
-    report = json.load(open(tmp_path / "report.json"))
+        in r.stderr
+    assert "proven-safe compiled-stream" in r.stderr
+    report = json.load(open(json_path))
     assert report["pass_counts"]["plan-audit"] >= 1
     assert report["pass_counts"]["num-audit"] == 0
     assert len(report["num_report"]) == 103
@@ -1624,17 +1643,15 @@ def test_lint_cli_gate(tmp_path):
     assert "cartesian-join" in r.stdout
 
 
-def test_lint_cli_format_json(tmp_path):
+def test_lint_cli_format_json(tmp_path, lint_combined):
     """--format json: stable machine-readable findings on stdout (rule,
     file, symbol, count, baselined) with the exit-code contract
     unchanged."""
-    r = _run_lint("--format", "json")
-    assert r.returncode == 0, r.stdout + r.stderr
-    doc = json.loads(r.stdout)
+    _r, doc, _path = lint_combined
     assert doc["version"] == 1
     assert set(doc["pass_counts"]) == {"plan-audit", "exec-audit",
                                        "mem-audit", "perf-audit",
-                                       "num-audit",
+                                       "num-audit", "param-audit",
                                        "jax-lint", "driver-audit",
                                        "conc-audit"}
     entries = doc["findings"]
@@ -1663,21 +1680,17 @@ def test_lint_cli_format_json(tmp_path):
                for e in doc["findings"])
 
 
-def test_lint_cli_stream_report():
-    r = _run_lint("--stream-report")
-    assert r.returncode == 0, r.stdout + r.stderr
-    assert "per-template execution-path classification" in r.stdout
+def test_lint_cli_stream_report(lint_combined):
+    r, doc, _path = lint_combined
+    assert "per-template execution-path classification" in r.stderr
     for klass in ("compiled-stream", "device-resident"):
-        assert klass in r.stdout
+        assert klass in r.stderr
     # multi-pass streaming: the report names the conversion mechanisms
     # that serve the formerly-eager statements
     for mech in ("streamed-subquery", "outer-gather", "outer-build"):
-        assert mech in r.stdout
+        assert mech in r.stderr
     # --format json: the machine-readable report carries the mechanism
     # field per scan, stdout stays ONE parseable document
-    r = _run_lint("--stream-report", "--format", "json")
-    assert r.returncode == 0, r.stdout + r.stderr
-    doc = json.loads(r.stdout)
     scans = [s for e in doc["stream_report"] for s in e["scans"]]
     assert any("streamed-subquery" in s["mechanisms"] for s in scans)
     assert any("outer-gather" in s["mechanisms"] for s in scans)
@@ -1697,36 +1710,28 @@ def test_stream_report_classification_counts_pinned():
     assert counts == {"compiled-stream": 96, "device-resident": 7}, counts
 
 
-def test_lint_cli_mem_report():
-    r = _run_lint("--mem-report")
-    assert r.returncode == 0, r.stdout + r.stderr
-    assert "per-statement peak-HBM byte bounds" in r.stdout
-    assert "capacity model" in r.stdout
+def test_lint_cli_mem_report(lint_combined):
+    r, doc, _path = lint_combined
+    assert "per-statement peak-HBM byte bounds" in r.stderr
+    assert "capacity model" in r.stderr
     # provable accumulators print their row bound; the multi-pass
     # conversions left no unprovable corpus scan (subquery conjuncts are
     # residual-planned filters now)
-    assert "rows, k=" in r.stdout
-    assert "unprovable (eager loop)" not in r.stdout
+    assert "rows, k=" in r.stderr
+    assert "unprovable (eager loop)" not in r.stderr
     # --format json keeps stdout a single document with the report inline
-    r = _run_lint("--mem-report", "--format", "json")
-    assert r.returncode == 0, r.stdout + r.stderr
-    doc = json.loads(r.stdout)
     assert len(doc["mem_report"]) >= 99
     assert all(e["peak_bytes"] > 0 for e in doc["mem_report"])
 
 
-def test_lint_cli_perf_report():
-    r = _run_lint("--perf-report")
-    assert r.returncode == 0, r.stdout + r.stderr
-    assert "per-statement static cost model" in r.stdout
-    assert "rates GB/s" in r.stdout
+def test_lint_cli_perf_report(lint_combined):
+    r, doc, _path = lint_combined
+    assert "per-statement static cost model" in r.stderr
+    assert "rates GB/s" in r.stderr
     # the pinned histogram rides the summary line
-    assert "h2d-bound" in r.stdout and "hbm-bound" in r.stdout
+    assert "h2d-bound" in r.stderr and "hbm-bound" in r.stderr
     # --format json keeps stdout ONE parseable document with the full
     # cost table inline — the machine-readable round trip
-    r = _run_lint("--perf-report", "--format", "json")
-    assert r.returncode == 0, r.stdout + r.stderr
-    doc = json.loads(r.stdout)
     entries = doc["perf_report"]
     assert len(entries) == 103
     for e in entries:
@@ -2093,7 +2098,10 @@ def test_lint_changed_covers_kernels():
               # numeric-safety layer: the value-range interpreter and
               # the saturating encoded-compare rebase it models
               "nds_tpu/analysis/num_audit.py",
-              "nds_tpu/engine/exprs.py"):
+              "nds_tpu/engine/exprs.py",
+              # parameterization layer: the literal-bindability prover
+              # whose shared rule the stream dispatcher imports
+              "nds_tpu/analysis/param_audit.py"):
         assert p.startswith(mod._CORPUS_ROOTS), \
             f"{p} not covered by _CORPUS_ROOTS"
 
@@ -2410,20 +2418,21 @@ def test_conc_audit_differential_harness():
 
 
 def test_lint_jobs_thread_pool_matches_sequential():
-    """--jobs N runs the eight passes in a thread pool with identical
+    """--jobs N runs the nine passes in a thread pool with identical
     findings/counts — the analysis layer passing its own audit, live."""
     import importlib.util
     path = os.path.join(REPO, "tools", "lint.py")
     spec = importlib.util.spec_from_file_location("lint_tool_j", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    f1, c1, _r1, _m1, _p1, _n1, _e1 = mod.run_passes(jobs=1)
-    f6, c6, _r6, _m6, _p6, _n6, _e6 = mod.run_passes(jobs=6)
+    f1, c1, _r1, _m1, _p1, _n1, _pp1, _e1 = mod.run_passes(jobs=1)
+    f6, c6, _r6, _m6, _p6, _n6, _pp6, _e6 = mod.run_passes(jobs=6)
     assert c1 == c6
     assert [str(f) for f in f1] == [str(f) for f in f6]
     assert "conc-audit" in c1
     assert "perf-audit" in c1
     assert "num-audit" in c1
+    assert "param-audit" in c1
 
 
 # ---------------------------------------------------------------------------
@@ -2533,3 +2542,240 @@ def test_num_audit_differential_harness():
     ok_b, lines_b = mod.compare(expect, [arms[0]], drift, arms[0])
     assert not ok_b, "widened-range drift fixture failed to fail"
     assert any("statically unproven" in ln for ln in lines_b)
+
+
+# ---------------------------------------------------------------------------
+# parameterization audit: literal bindability + one-compile-many-params
+# ---------------------------------------------------------------------------
+
+
+def test_param_literal_rule():
+    """The shared bindability vocabulary: type tags, safe domains and
+    the operand conversion the stream dispatcher feeds jnp.asarray."""
+    from decimal import Decimal
+
+    from nds_tpu.analysis.param_audit import (SAFE_INT_ABS, domain_contains,
+                                              literal_typetag,
+                                              slot_param_value)
+    assert literal_typetag(42) == "i64"
+    assert literal_typetag(1.5) == "f64"
+    assert literal_typetag(Decimal("99.99")) == "dec:2"
+    assert literal_typetag(Decimal("7")) == "dec:0"
+    # None / bool / str never bind (codec selection, plan-time parses)
+    for v in (None, True, "GA"):
+        assert literal_typetag(v) is None
+    # i64: inside the rebase margin, not at it
+    assert domain_contains("i64", SAFE_INT_ABS - 1)
+    assert not domain_contains("i64", SAFE_INT_ABS + 1)
+    # dec:s domains live in LITERAL units; operands in scaled ints
+    assert domain_contains("dec:2", Decimal("99999.99"))
+    assert slot_param_value(Decimal("99999.99"), "dec:2") == 9999999
+    assert slot_param_value(5, "i64") == 5
+    # f64 binds at any finite value (no codec or rebase interaction)
+    assert domain_contains("f64", 1e300)
+
+
+def test_param_audit_statement_classification():
+    """One statement, every verdict family: direct streamed comparands
+    bind; dimension-owned, in-list, subquery and LIMIT literals fold
+    with machine-readable reasons."""
+    from nds_tpu.analysis.param_audit import ParamAuditor
+    a = ParamAuditor()
+    rep = a.audit_sql("""
+        select ss_item_sk, count(*) c from store_sales, date_dim
+        where ss_sold_date_sk = d_date_sk
+          and ss_quantity between 5 and 95
+          and ss_ext_sales_price > 100.00
+          and d_moy = 11
+          and ss_item_sk in (1, 2, 3)
+          and ss_wholesale_cost > (select avg(ss_wholesale_cost)
+                                   from store_sales)
+        group by ss_item_sk order by ss_item_sk limit 10""")
+    assert rep.classification == "compiled-stream"
+    # between low/high + the decimal compare = three bindable slots
+    assert rep.n_bindable == 3
+    assert rep.signature() == ("ss_quantity:i64, ss_quantity:i64, "
+                               "ss_ext_sales_price:dec:2")
+    assert all(s.domain for s in rep.slots)
+    # 3 in-list members + LIMIT shape the output; d_moy is dimension-
+    # owned (its compare replays against a host-gathered dimension)
+    assert rep.folds == {"shape-affecting": 4, "replayed-host-read": 1}
+    # every literal is accounted for: bound or folded, none dropped
+    assert sum(rep.folds.values()) + rep.n_bindable == rep.n_literals
+
+
+def test_param_skeleton_key_canonicalization():
+    """The cache-key half of the contract: swapping a bindable literal's
+    VALUE leaves the skeleton key unchanged (one compile serves all
+    vectors), while changing its decimal SCALE — a different codec
+    layout — changes it."""
+    from nds_tpu.analysis.exec_audit import _conjuncts_of
+    from nds_tpu.analysis.param_audit import (conjunct_bind_slots,
+                                              skeleton_conjunct_key)
+    from nds_tpu.sql.parser import parse
+
+    def conj(sql):
+        q = parse(sql).body
+        return _conjuncts_of(q.where)[0]
+
+    def skel(sql):
+        c = conj(sql)
+        slots = conjunct_bind_slots(c, owned=True, has_subquery=False)
+        assert slots, sql
+        return skeleton_conjunct_key(c, [(p, n, t) for p, n, t in slots])
+
+    base = "select 1 from store_sales where ss_ext_sales_price > {}"
+    assert skel(base.format("100.00")) == skel(base.format("9999.99"))
+    assert skel(base.format("100.00")) != skel(base.format("100.0"))
+    # the swap restores the literal value afterwards
+    c = conj(base.format("100.00"))
+    skeleton_conjunct_key(
+        c, [(p, n, t) for p, n, t in
+            conjunct_bind_slots(c, owned=True, has_subquery=False)])
+    from decimal import Decimal
+    assert c.right.value == Decimal("100.00")
+
+
+def test_param_binding_hook_roundtrip():
+    """The engine half: exprs.param_binding overlays a Literal node's
+    value as a broadcast device column inside the scope and stands down
+    outside it (the planner consults bound_literal before X.literal)."""
+    from nds_tpu.engine import exprs as X
+    from nds_tpu.sql.parser import parse
+    q = parse("select 1 from store_sales where ss_quantity > 5").body
+    lit = q.where.right
+    assert X.bound_literal(lit, 4) is None
+    assert not X.param_bindings_active()
+    with X.param_binding({id(lit): ("i64", 37)}):
+        assert X.param_bindings_active()
+        col = X.bound_literal(lit, 4)
+        assert col is not None and int(col.data[0]) == 37
+        assert col.data.shape == (4,)
+    assert X.bound_literal(lit, 4) is None
+
+
+def test_param_audit_corpus_counts_pinned():
+    """The corpus bindability census is a tier-1 contract, pinned like
+    the perf bottleneck and num proof histograms: a rule change that
+    silently binds more (unsound) or fewer (lost coverage) literals
+    must fail loudly. Update ONLY together with the matching engine
+    change — the lockstep rule."""
+    import time
+
+    from nds_tpu.analysis.param_audit import (audit_param_corpus,
+                                              bindability_counts,
+                                              reports_to_findings)
+    t0 = time.time()
+    reports = audit_param_corpus()
+    elapsed = time.time() - t0
+    assert len(reports) == 103
+    assert reports_to_findings(reports) == []
+    assert elapsed < 60, f"host-only audit took {elapsed:.1f}s"
+    assert bindability_counts(reports) == {
+        "bindable": 63,
+        "codec-threshold": 267,
+        "date-parse-at-plan": 23,
+        "non-comparand": 315,
+        "non-streamed-statement": 714,
+        "replayed-host-read": 599,
+        "residual-key": 13,
+        "shape-affecting": 86,
+        "statements-with-bindable": 7,
+    }
+    # every bindable slot the pinned-seed instantiation produced sits
+    # inside its proven safe domain with a live signature
+    for r in reports:
+        for s in r.slots:
+            assert s.typetag in ("i64", "f64") or \
+                s.typetag.startswith("dec:")
+        if r.n_bindable:
+            assert r.signature()
+
+
+def test_param_generator_dials_inside_safe_domains():
+    """Satellite lockstep with the stream generator: every numeric dial
+    range a template defines (uniform/sample bounds — what
+    nds_gen_query_stream substitutes per stream) sits inside the proven
+    safe i64 domain, and instantiations under OTHER seeds than the
+    audit's pinned one keep every bindable slot value in-domain."""
+    import re
+
+    import numpy as np
+
+    from nds_tpu.analysis.param_audit import (SAFE_INT_ABS, ParamAuditor,
+                                              domain_contains)
+    from nds_tpu.queries import (_DEFINE_RE, instantiate_template,
+                                 list_templates, load_template)
+    call = re.compile(r"^(\w+)\((.*)\)$", re.DOTALL)
+    n_dials = 0
+    for name in list_templates():
+        for m in _DEFINE_RE.finditer(load_template(name)):
+            c = call.match(m.group(2).strip())
+            if not c or c.group(1) not in ("uniform", "sample"):
+                continue
+            args = [a.strip() for a in c.group(2).split(",")]
+            bounds = args[-2:] if c.group(1) == "sample" else args
+            for tok in bounds:
+                if re.fullmatch(r"-?\d+", tok):
+                    assert abs(int(tok)) < SAFE_INT_ABS, \
+                        f"{name}: dial bound {tok} escapes the domain"
+                    n_dials += 1
+    assert n_dials >= 20, "the dial scan went dark"
+    auditor = ParamAuditor()
+    for seed in (7, 4242):
+        rng = np.random.default_rng(seed)
+        for name in list_templates():
+            sql = instantiate_template(load_template(name), rng)
+            for stmt in (s for s in sql.split(";") if s.strip()):
+                rep = auditor.audit_sql(stmt, file=name, query=name)
+                for s in rep.slots:
+                    assert s.value is None or \
+                        domain_contains(s.typetag, s.value), \
+                        (name, s.column, s.value)
+
+
+def test_lint_cli_param_report(lint_combined):
+    """--param-report plumbing under --format json: the ``param_report``
+    field rides the SAME single parseable stdout document and the human
+    signature table rides stderr (one subprocess covers both — the
+    plain-stdout rendering is the same format_param_report text)."""
+    r, doc, _path = lint_combined         # single-document stdout
+    assert doc["pass_counts"]["param-audit"] == 0
+    entries = doc["param_report"]
+    assert len(entries) == 103
+    assert sum(1 for e in entries if e["slots"]) == 7
+    for e in entries:
+        for s in e["slots"]:
+            assert s["typetag"] in ("i64", "f64") or \
+                s["typetag"].startswith("dec:")
+    # the human signature table rides stderr, off the parseable stream
+    assert "# param-audit: literal bindability" in r.stderr
+    assert "ss_quantity:i64" in r.stderr
+    assert "bindable: 63" in r.stderr
+
+
+def test_param_audit_differential_harness():
+    """The one-compile-many-params lockstep, live: K=4 boundary
+    parameter vectors per bindable template share ONE compiled pipeline
+    (singleflight build counters + cache hit/miss metrics) bit-for-bit
+    with per-value fresh recording AND the plain-width eager reference,
+    fold-required slots keep changing the cache key, and the static
+    signatures match the runtime slot counts — across the base,
+    partitioned and (mesh permitting) sharded arms."""
+    path = os.path.join(REPO, "tools", "param_audit_diff.py")
+    spec = importlib.util.spec_from_file_location("param_audit_diff_t",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    ok, lines = mod.run_diff(inject_drift=False)
+    assert ok, "\n".join(lines)
+    assert any("ONE compile served 4 parameter vectors" in ln
+               for ln in lines)
+    assert any("fold-required slots changed the key" in ln
+               for ln in lines)
+    # the drift self-test: misclassifying IN-list members as bindable
+    # must be rejected in BOTH directions (wrong results on cache hit,
+    # fold slots no longer varying the key)
+    ok_d, lines_d = mod.run_diff(inject_drift=True)
+    assert ok_d, "\n".join(lines_d)
+    assert any("correctly rejected" in ln for ln in lines_d)

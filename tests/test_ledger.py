@@ -539,6 +539,35 @@ def test_ab_num_audit_inject_drift_must_fail(bench_compare, ab_ledger):
     assert any("statically unproven" in ln for ln in lines_s)
 
 
+def test_ab_ledger_compile_evidence_matches_param_audit(bench_compare,
+                                                        ab_ledger):
+    """--audit-param: every pinned A/B statement the param audit proves
+    bindable slots for must carry compiled-path streamed-scan evidence
+    in the ledger (the one-compile-many-params contract needs a
+    compiled program to re-serve), and compiled evidence must never sit
+    under a non-streamed classification. The sweep must yield at least
+    one bindable slot — the rule going dark is itself a failure."""
+    ok, lines = bench_compare.audit_param(ab_ledger)
+    assert ok, "\n".join(lines)
+    assert sum(1 for ln in lines if ln.startswith("ok [")) == 14
+    # the streamed-fact direct-comparand statements carry signatures
+    assert any("bindable slots [" in ln for ln in lines)
+
+
+def test_ab_param_audit_inject_drift_must_fail(bench_compare, ab_ledger):
+    """Both drift directions: eager-rewritten scan paths under proven
+    bindable slots, and an empty streamed set (every classification
+    drifts off compiled-stream) against compiled evidence — each MUST
+    be rejected on its own."""
+    ok_r, lines_r = bench_compare.audit_param(ab_ledger,
+                                              inject="runtime")
+    assert not ok_r, "eager-rewritten paths must be rejected"
+    assert any("no compiled program" in ln for ln in lines_r)
+    ok_s, lines_s = bench_compare.audit_param(ab_ledger, inject="static")
+    assert not ok_s, "drifted classifications must be rejected"
+    assert any("misclassified statement" in ln for ln in lines_s)
+
+
 # ---------------------------------------------------------------------------
 # evidence schema round-trip: every event field reaches the ledger
 # ---------------------------------------------------------------------------

@@ -71,6 +71,28 @@ def test_empty_window_rollups():
     stream = r.stream_rollup(0.0)
     assert stream["queries"] == 0 and "qps" not in stream
     assert "wallP50Ms" not in stream
+    # pipeline-cache counters ride the rollups only once nonzero
+    assert "pipeHit" not in roll and "pipeMiss" not in stream
+
+
+def test_pipeline_cache_counters_ride_rollups_and_monitor():
+    """The cache-efficacy evidence (stream dispatch feeds hit/miss at
+    the keyed lookup, evict at every cache pop) lands in both ledger
+    rollup scopes and renders in the obs_live pipe column."""
+    r = M.Registry(clock=lambda: 10.0)
+    r.inc(M.PIPE_MISS)
+    r.inc(M.PIPE_HIT, 3)
+    roll = r.query_rollup()
+    assert roll["pipeHit"] == 3 and roll["pipeMiss"] == 1
+    assert "pipeEvict" not in roll           # zero stays absent
+    stream = r.stream_rollup(0.0)
+    assert stream["pipeHit"] == 3 and stream["pipeMiss"] == 1
+    ol = _load_tool("obs_live")
+    row = ol._row_stats(r.snapshot(), now=10.0)
+    assert row["pipeHit"] == 3 and row["pipeMiss"] == 1
+    lines = ol.render([("arm", r.snapshot())], now=10.0)
+    assert any("pipe h/m" in ln for ln in lines)
+    assert any(" 3/1 " in ln for ln in lines)
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,32 @@ CI_QUERIES = [
 ]
 
 
+def _load_sqlite_cached(load_sqlite, data_dir):
+    """The oracle DB, persisted next to the generated data: the pure-
+    Python ``|``-CSV parse + insert + index build over SF0.01 costs ~2
+    minutes of the suite on one core, and its input is the immutable
+    cached dataset — so build once, ``backup()`` to a file keyed by the
+    data marker's mtime, and reopen on later runs. The tests only ever
+    SELECT, so a plain file connection is safe."""
+    import sqlite3
+
+    db_path = os.path.join(data_dir, "oracle_sqlite.db")
+    marker = os.path.join(data_dir, ".complete")
+    if os.path.exists(db_path) and os.path.exists(marker) and \
+            os.path.getmtime(db_path) >= os.path.getmtime(marker):
+        return sqlite3.connect(db_path)
+    con = load_sqlite(data_dir)
+    tmp = db_path + ".tmp"
+    if os.path.exists(tmp):
+        os.remove(tmp)
+    disk = sqlite3.connect(tmp)
+    with disk:
+        con.backup(disk)
+    disk.close()
+    os.replace(tmp, db_path)
+    return con
+
+
 @pytest.fixture(scope="module")
 def oracle_setup():
     os.environ.setdefault("NDS_TPU_COMP_CACHE", "force")
@@ -50,7 +76,7 @@ def oracle_setup():
         generate_query_streams(stream_dir, streams=1, rngseed=19620718,
                                scale=0.01)
     queries = gen_sql_from_stream(stream_file)
-    con = load_sqlite(data_dir)
+    con = _load_sqlite_cached(load_sqlite, data_dir)
     session = Session()
     for tname, fields in get_schemas(use_decimal=True).items():
         path = os.path.join(data_dir, f"{tname}.dat")

@@ -785,6 +785,76 @@ def audit_num(path, inject=None):
     return ok, lines
 
 
+def audit_param(path, inject=None):
+    """Cross-validate a recorded A/B ledger against the static literal-
+    BINDABILITY proofs: a statement param_audit proves bindable slots
+    for must be classified compiled-stream AND carry compiled-path
+    streamed-scan evidence in the ledger (bindable literals only ride
+    as jit operands of a compiled chunk pipeline — eager evidence means
+    there is no one-compile program to re-serve), and conversely a
+    record whose scans all took the compiled path must not sit under a
+    statement the param audit classifies as non-streamed (bindability
+    proofs standing on a misclassified statement are unproven).
+    ``inject`` is the two-direction drift self-test that MUST fail:
+    ``"runtime"`` rewrites every recorded scan path to eager (proven
+    slots contradicted), ``"static"`` audits with an EMPTY streamed set
+    so the compiled evidence contradicts the classifications."""
+    from nds_tpu.obs.ledger import load_ledger
+
+    data = load_ledger(path)
+    mod = _load_ab_module()
+    queries = mod._STREAM_AB_QUERIES
+    with mod._forced_stream_partitions():
+        from nds_tpu.analysis.exec_audit import CLASS_COMPILED
+        from nds_tpu.analysis.param_audit import ParamAuditor
+        auditor = ParamAuditor(
+            streamed=frozenset() if inject == "static" else None)
+        reports = [auditor.audit_sql(sql, query=f"ab{i + 1}")
+                   for i, (sql, _m) in enumerate(queries)]
+    ok = True
+    lines = []
+    n_slots = 0
+    for i, (sql, _must) in enumerate(queries):
+        name = f"ab{i + 1}"
+        rec = data.queries.get(name)
+        rep = reports[i]
+        if rec is None:
+            ok = False
+            lines.append(f"MISMATCH [{name}] no ledger record")
+            continue
+        paths = [s.get("path", "") for s in
+                 (rec.get("streamedScans") or [])]
+        if inject == "runtime":
+            paths = ["eager" for _ in paths] or ["eager"]
+        compiled_evidence = bool(paths) and \
+            all(p == "compiled" for p in paths)
+        if rep.n_bindable and not (rep.classification == CLASS_COMPILED
+                                   and compiled_evidence):
+            ok = False
+            lines.append(
+                f"MISMATCH [{name}] {rep.n_bindable} bindable slots "
+                f"proven but the evidence is {rep.classification} / "
+                f"paths {sorted(set(paths))} — no compiled program for "
+                "the parameter operands to re-serve")
+        elif compiled_evidence and rep.classification != CLASS_COMPILED:
+            ok = False
+            lines.append(
+                f"MISMATCH [{name}] ledger records the compiled stream "
+                f"path but the param audit classifies the statement "
+                f"{rep.classification} — its bindability verdicts stand "
+                "on a misclassified statement")
+        else:
+            n_slots += rep.n_bindable
+            sig = f" [{rep.signature()}]" if rep.n_bindable else ""
+            lines.append(f"ok [{name}] {rep.n_bindable} bindable "
+                         f"slots{sig} on {rep.classification} evidence")
+    if ok and inject is None and n_slots == 0:
+        ok = False
+        lines.append("MISMATCH: the A/B corpus yielded ZERO bindable "
+                     "slots — the bindability rule went dark")
+    return ok, lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="diff two campaign evidence ledgers / bench rounds; "
@@ -824,6 +894,11 @@ def main(argv=None) -> int:
                     help="cross-validate a recorded A/B ledger's "
                     "overflow-flag evidence against the num_audit "
                     "value-range proofs (proven <=> no overflow rerun)")
+    ap.add_argument("--audit-param", metavar="PATH",
+                    help="cross-validate a recorded A/B ledger's "
+                    "compiled-path evidence against the param_audit "
+                    "bindability proofs (bindable slots <=> compiled "
+                    "stream evidence)")
     args = ap.parse_args(argv)
 
     if args.record_ab:
@@ -895,6 +970,34 @@ def main(argv=None) -> int:
         print("# numeric evidence check FAILED: a static verdict "
               "contradicts the recorded overflow evidence (model drift "
               "or engine regression)")
+        return 1
+
+    if args.audit_param:
+        if args.inject_drift:
+            # both drift directions must be rejected for exit 0
+            ok_r, lines_r = audit_param(args.audit_param,
+                                        inject="runtime")
+            ok_s, lines_s = audit_param(args.audit_param,
+                                        inject="static")
+            for ln in lines_r + lines_s:
+                print(ln)
+            if ok_r or ok_s:
+                print("# DRIFT FIXTURE FAILED TO FAIL: the bindability "
+                      "evidence check cannot catch a drifted proof")
+                return 1
+            print("# both drift directions correctly rejected "
+                  "(bindability evidence check is live)")
+            return 0
+        ok, lines = audit_param(args.audit_param)
+        for ln in lines:
+            print(ln)
+        if ok:
+            print("# ledger compiled-path evidence agrees with the "
+                  "param_audit bindability proofs")
+            return 0
+        print("# bindability evidence check FAILED: a bindability "
+              "verdict contradicts the recorded stream-path evidence "
+              "(model drift or engine regression)")
         return 1
 
     if args.emit_perf:

@@ -358,12 +358,16 @@ def _probe_specs():
                 len(stream._PIPELINE_BUILD_COUNTS))
 
     def pipeline_mutate():
+        # the fresh literal must be FOLD-REQUIRED (an IN-list member,
+        # per param_audit): a bare comparand is a bindable slot, so the
+        # skeleton cache key would repeat and the mutate would shortcut
+        # through a pipeline-cache hit without touching the lock
         with mod._forced_stream_partitions():
             session = _probe_sessions["chunked"]
-            thr = 9000 + fresh()
+            a = 9000 + fresh()
             session.sql(
                 "select ss_item_sk, ss_ext_sales_price from store_sales "
-                f"where ss_ext_sales_price > {thr} and ss_item_sk < 40 "
+                f"where ss_item_sk in ({a}, {a + 1}) "
                 "order by ss_item_sk, ss_ext_sales_price").collect()
 
     def fuse_observe():

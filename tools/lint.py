@@ -1,7 +1,7 @@
 # Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
-"""Static analysis gate: plan/exec/mem/conc/perf/num auditors + engine/driver lint.
+"""Static analysis gate: plan/exec/mem/conc/perf/num/param auditors + engine/driver lint.
 
-Runs the eight :mod:`nds_tpu.analysis` passes entirely on host (no device,
+Runs the nine :mod:`nds_tpu.analysis` passes entirely on host (no device,
 no data) and exits nonzero when any finding is NOT covered by the
 checked-in baseline (``nds_tpu/analysis/baseline.json``) — the accepted
 pre-existing findings. New code must come in clean; accepting a new
@@ -21,6 +21,9 @@ Usage:
                                               # roofline walls (perf-audit)
     python tools/lint.py --num-report         # per-statement value-range /
                                               # precision proofs (num-audit)
+    python tools/lint.py --param-report       # per-statement literal
+                                              # bindability / parameter
+                                              # signatures (param-audit)
     python tools/lint.py --changed            # lint only files in the
                                               # current git diff
     python tools/lint.py --jobs 6             # run the passes in a thread
@@ -64,6 +67,10 @@ from nds_tpu.analysis.num_audit import (audit_num_corpus,  # noqa: E402
                                         claim_findings, format_num_report)
 from nds_tpu.analysis.num_audit import \
     reports_to_findings as num_reports_to_findings  # noqa: E402
+from nds_tpu.analysis.param_audit import (audit_param_corpus,  # noqa: E402
+                                          format_param_report)
+from nds_tpu.analysis.param_audit import \
+    reports_to_findings as param_reports_to_findings  # noqa: E402
 from nds_tpu.analysis.perf_audit import (audit_perf_corpus,  # noqa: E402
                                          format_perf_report)
 from nds_tpu.analysis.perf_audit import \
@@ -140,6 +147,13 @@ def git_changed_files():
 # nds_tpu/engine/exprs.py (same rationale, named despite the engine
 # prefix): the saturating encoded-compare rebase it implements is the
 # exact semantics num_audit's rebase checks assume.
+# nds_tpu/analysis/param_audit.py (explicit for the same reason) is the
+# literal-bindability prover whose shared rule (conjunct_bind_slots,
+# skeleton keys, safe domains) engine/stream.py imports at dispatch to
+# decide which literals ride as jit operands and how the pipeline-cache
+# key canonicalizes — bindability-rule edits rerun the corpus passes so
+# tools/param_audit_diff.py's one-compile-many-params proof and the
+# pinned corpus census never drift from what the engine actually binds.
 # nds_tpu/obs/campaign.py (explicit for the same reason) is the
 # unattended multi-arm driver: its arm-failure handling is a direct
 # client of the swallowed-fault rule's contract (bench-child seam,
@@ -167,7 +181,8 @@ _CORPUS_ROOTS = ("nds_tpu/queries", "nds_tpu/analysis", "nds_tpu/sql",
                  "nds_tpu/obs/metrics.py",
                  "tools/obs_live.py",
                  "nds_tpu/analysis/num_audit.py",
-                 "nds_tpu/engine/exprs.py")
+                 "nds_tpu/engine/exprs.py",
+                 "nds_tpu/analysis/param_audit.py")
 
 
 def run_passes(template_dir=None, changed=None, want_reports=False,
@@ -180,8 +195,8 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
     (templates, sources) and appends only to its own lists, the exact
     discipline the conc-audit pass itself enforces — findings stay in
     the fixed pass order either way. Returns (findings, pass counts,
-    exec reports, mem reports, perf reports, num reports, elapsed
-    seconds)."""
+    exec reports, mem reports, perf reports, num reports, param
+    reports, elapsed seconds)."""
     t0 = time.time()
     findings = []
     counts = {}
@@ -189,6 +204,7 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
     mem_reports = []
     perf_reports = []
     num_reports = []
+    param_reports = []
     corpus_affected = (
         changed is None or template_dir is not None or want_reports
         or any(c.startswith(_CORPUS_ROOTS) for c in changed))
@@ -208,6 +224,10 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
     def run_num():
         num_reports.extend(audit_num_corpus(template_dir))
         return num_reports_to_findings(num_reports) + claim_findings()
+
+    def run_param():
+        param_reports.extend(audit_param_corpus(template_dir))
+        return param_reports_to_findings(param_reports)
 
     def run_jax():
         if changed is None:
@@ -237,6 +257,7 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
         passes.append(("mem-audit", run_mem))
         passes.append(("perf-audit", run_perf))
         passes.append(("num-audit", run_num))
+        passes.append(("param-audit", run_param))
     passes.append(("jax-lint", run_jax))
     passes.append(("driver-audit", run_drivers))
     # the concurrency audit is a whole-package pass: any nds_tpu edit
@@ -255,7 +276,7 @@ def run_passes(template_dir=None, changed=None, want_reports=False,
         counts[name] = len(got)
         findings.extend(got)
     return (findings, counts, reports, mem_reports, perf_reports,
-            num_reports, time.time() - t0)
+            num_reports, param_reports, time.time() - t0)
 
 
 def _aggregate(findings, new):
@@ -303,6 +324,10 @@ def main(argv=None) -> int:
                     help="print the num-audit per-statement value-range/"
                     "precision proofs (codec fit, rebase, accumulators, "
                     "hash route bits)")
+    ap.add_argument("--param-report", action="store_true",
+                    help="print the param-audit per-statement literal "
+                    "bindability classification and parameter "
+                    "signatures (the one-compile-many-params worklist)")
     ap.add_argument("--changed", action="store_true",
                     help="fast path: lint only files in the current git "
                     "diff (full run when not in a git checkout)")
@@ -328,10 +353,11 @@ def main(argv=None) -> int:
     changed = git_changed_files() if args.changed else None
 
     findings, counts, reports, mem_reports, perf_reports, num_reports, \
-        elapsed = run_passes(
+        param_reports, elapsed = run_passes(
             args.templates, changed=changed,
             want_reports=(args.stream_report or args.mem_report
-                          or args.perf_report or args.num_report),
+                          or args.perf_report or args.num_report
+                          or args.param_report),
             jobs=max(args.jobs, 1))
 
     # diff against the PRE-update baseline so a --json report written
@@ -355,6 +381,8 @@ def main(argv=None) -> int:
             doc["perf_report"] = [r.to_dict() for r in perf_reports]
         if num_reports:
             doc["num_report"] = [r.to_dict() for r in num_reports]
+        if param_reports:
+            doc["param_report"] = [r.to_dict() for r in param_reports]
         with open(args.json, "w") as f:
             json.dump(doc, f, indent=1)
 
@@ -378,6 +406,8 @@ def main(argv=None) -> int:
         print(format_perf_report(perf_reports), file=out)
     if args.num_report and num_reports:
         print(format_num_report(num_reports), file=out)
+    if args.param_report and param_reports:
+        print(format_param_report(param_reports), file=out)
     for f in new:
         print(f"NEW {f}", file=out)
     n_err = sum(1 for f in new if f.severity == "error")
@@ -397,6 +427,8 @@ def main(argv=None) -> int:
             doc["perf_report"] = [r.to_dict() for r in perf_reports]
         if args.num_report and num_reports:
             doc["num_report"] = [r.to_dict() for r in num_reports]
+        if args.param_report and param_reports:
+            doc["param_report"] = [r.to_dict() for r in param_reports]
         print(json.dumps(doc, indent=1))
     if new:
         print("# gate FAILED: fix the findings above, suppress with "

@@ -77,6 +77,12 @@ def _row_stats(doc, now):
         "errors": (counters.get("queries.error", 0)
                    + counters.get("queries.timeout", 0)),
         "faults": counters.get("faults.total", 0),
+        # streamed pipeline-cache effectiveness: hits = compiles avoided
+        # (parameterized plans re-serving one compile), evictions =
+        # capacity/staleness churn worth noticing mid-run
+        "pipeHit": counters.get("pipeline.cache.hit", 0),
+        "pipeMiss": counters.get("pipeline.cache.miss", 0),
+        "pipeEvict": counters.get("pipeline.cache.evict", 0),
         "qpm": roll.get("perMin"),
         "rollP99": roll.get("p99"),
         "ewma": wall.get("ewma"),
@@ -105,7 +111,7 @@ def render(snapshots, now=None):
                 "set on the run?)"]
     hdr = (f"{'source':<18} {'prog':>9} {'q/min':>7} {'p99ms':>9} "
            f"{'ewma':>8} {'stall%':>6} {'flt':>4} {'err':>4} "
-           f"{'age_s':>6}  last")
+           f"{'pipe h/m':>9} {'age_s':>6}  last")
     lines = ["# live metrics (rolling window rollups; age = snapshot "
              "staleness)", hdr]
     wall_snaps = []
@@ -118,11 +124,17 @@ def render(snapshots, now=None):
         last = s["query"] or ""
         if s["phase"]:
             last = f"{last} [{s['phase']}]" if last else f"[{s['phase']}]"
+        if s["pipeHit"] or s["pipeMiss"]:
+            pipe = f"{s['pipeHit']}/{s['pipeMiss']}"
+            if s["pipeEvict"]:
+                pipe += f"-{s['pipeEvict']}"
+        else:
+            pipe = "-"
         lines.append(
             f"{label[:18]:<18} {prog:>9} {_fmt(s['qpm']):>7} "
             f"{_fmt(s['rollP99']):>9} {_fmt(s['ewma']):>8} "
             f"{_fmt(s['stallPct']):>6} {s['faults']:>4} {s['errors']:>4} "
-            f"{_fmt(s['age']):>6}  {last}")
+            f"{pipe:>9} {_fmt(s['age']):>6}  {last}")
         wall = _hist(doc, QUERY_WALL)
         if wall is not None:
             wall_snaps.append(wall)
@@ -131,7 +143,7 @@ def render(snapshots, now=None):
         roll = merged["rolling"]
         lines.append(
             f"{'TOTAL':<18} {'':>9} {'':>7} {_fmt(roll['p99']):>9} "
-            f"{'':>8} {'':>6} {'':>4} {'':>4} {'':>6}  "
+            f"{'':>8} {'':>6} {'':>4} {'':>4} {'':>9} {'':>6}  "
             f"merged {merged['count']} walls, cum p50/p99 "
             f"{_fmt(merged['p50'])}/{_fmt(merged['p99'])} ms")
     return lines

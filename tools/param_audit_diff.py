@@ -1,0 +1,385 @@
+# Copyright (c) 2026, nds-tpu authors. Licensed under the Apache License, Version 2.0.
+"""Parameterization differential harness: one compile, many parameter
+vectors, bit-for-bit — static bindability proofs vs the live engine.
+
+``analysis/param_audit.py`` PROVES, per corpus statement, which WHERE
+literals can become jit operands of the one compiled per-chunk program
+(the pipeline-cache key then canonicalizes to the template skeleton).
+This harness is the check against the real engine:
+
+* drive bindable templates through K=4 boundary parameter vectors —
+  drawn from the stream generator's dial ranges (``uniform(0,100)``
+  quantity dials) and num_audit's edge values (decimal(7,2) at one cent
+  under its extreme) — under the default bind mode, asserting
+  EXACTLY-ONE compile per template via the per-shape singleflight
+  counters (``pipeline_build_counts``), K-1 cache hits in the metrics
+  plane, and bit-for-bit equality against per-value fresh recording
+  (``NDS_TPU_PARAM_BIND=0``, cache reset per vector) AND the resident
+  plain-width eager reference;
+
+* assert the NEGATIVE direction: a FOLD-REQUIRED template (IN-list
+  members — ``_eval_in_list`` bakes them into a host-built device
+  array) takes K distinct cache keys, one compile per vector;
+
+* audit the same statements with :class:`ParamAuditor` and demand
+  lockstep: the static slot count per template equals the slot count
+  the runtime bound (the bindable templates' signatures are non-empty,
+  the fold template's is empty);
+
+* repeat the bind sweep under the partitioned arm
+  (``NDS_TPU_STREAM_PARTITIONS=2``) and — when the mesh allows — the
+  sharded arm (``NDS_TPU_STREAM_SHARDS=2``): the bound operands ride
+  replicated, the per-(shape, arm) compile stays ONE.
+
+``--inject-drift`` (``NDS_TPU_PARAM_DRIFT=1``) is the MUST-fail
+self-test: the shared rule deliberately misclassifies IN-list members
+as bindable comparands, and the harness must reject BOTH directions —
+
+* direction A (results): the skeleton key now collapses the K in-list
+  vectors onto one entry whose compiled program baked the FIRST
+  vector's ``jnp.isin`` values (the in-list eval reads item values on
+  host, past the binding), so cache hits return the wrong rows —
+  bit-for-bit comparison must flag it;
+* direction B (key variance): the fold-required slots no longer change
+  the cache key, so the negative direction's K-distinct-keys assertion
+  must flag it.
+
+Exit 0 under ``--inject-drift`` only when both directions are
+correctly rejected.  Run by tier-1 via tests/test_analysis.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
+_N_FACT = 8192                # 4 chunks at 2048
+_N_ITEMS = 100
+_HOT_ITEM = 7                 # deterministic hot key: in-list vectors
+#                               containing it count very differently
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    """Set env vars for one arm, always restoring the previous values."""
+    old = {k: os.environ.get(k) for k in kv}
+    try:
+        for k, v in kv.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _toy_tables(rng):
+    """Small fact + dims under real catalog names (so the static
+    auditor classifies them with the production streamed set)."""
+    from decimal import Decimal
+
+    import numpy as np
+    import pyarrow as pa
+
+    n = _N_FACT
+    item_sk = rng.integers(1, _N_ITEMS + 1, n)
+    item_sk[: n // 4] = _HOT_ITEM        # hot key, then shuffled
+    rng.shuffle(item_sk)
+    cents = rng.integers(0, 10 ** 6, n)
+    cents[0] = 10 ** 7 - 1               # dec(7,2) extreme kept live
+    store_sales = pa.table({
+        "ss_item_sk": pa.array(item_sk, pa.int64()),
+        "ss_quantity": pa.array(rng.integers(0, 101, n), pa.int64()),
+        "ss_ext_sales_price": pa.array(
+            [Decimal(int(c)) / 100 for c in cents], pa.decimal128(7, 2)),
+    })
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(1, _N_ITEMS + 1), pa.int64()),
+        "i_brand_id": pa.array(1 + np.arange(_N_ITEMS) % 7, pa.int64()),
+    })
+    return {"store_sales": store_sales, "item": item}
+
+
+# Each template: K=4 parameter vectors. Values are pre-formatted SQL
+# fragments so decimal SCALE is pinned (the typetag "dec:2" is part of
+# the slot signature — "100.0" and "100.00" are DIFFERENT skeletons).
+# Vector provenance: quantity dials mirror the stream generator's
+# uniform(0,100) substitution range (edges included); price bounds pin
+# num_audit's decimal(7,2) extreme at one cent under the top.
+_TEMPLATES = (
+    {"name": "scan-i64", "bindable": True, "slots": 1,
+     "sql": lambda v: ("select count(*) c, sum(ss_quantity) q "
+                       f"from store_sales where ss_quantity > {v[0]}"),
+     "vectors": (("0",), ("37",), ("80",), ("100",))},
+    {"name": "join-dec-between", "bindable": True, "slots": 2,
+     "sql": lambda v: (
+         "select i_brand_id, count(*) c, sum(ss_ext_sales_price) s "
+         "from store_sales, item where ss_item_sk = i_item_sk "
+         f"and ss_ext_sales_price between {v[0]} and {v[1]} "
+         "group by i_brand_id order by i_brand_id"),
+     "vectors": (("0.01", "9999.99"), ("100.00", "5000.00"),
+                 ("2500.50", "7500.50"), ("99999.97", "99999.98"))},
+    {"name": "fold-inlist", "bindable": False, "slots": 0,
+     "sql": lambda v: ("select count(*) c from store_sales "
+                       f"where ss_item_sk in ({v[0]}, {v[1]})"),
+     "vectors": ((str(_HOT_ITEM), "9"), ("5", "11"), ("2", "88"),
+                 ("40", "41"))},
+)
+
+_ARMS = (
+    ("base", {}),
+    ("partitioned", {"NDS_TPU_STREAM_PARTITIONS": "2"}),
+    ("sharded", {"NDS_TPU_STREAM_SHARDS": "2"}),
+)
+
+
+def _make_session(tables, chunked):
+    from nds_tpu.engine.session import Session
+    from nds_tpu.engine.table import ChunkedTable
+    s = Session()
+    for name, tbl in tables.items():
+        if chunked and name == "store_sales":
+            s.create_temp_view(name, ChunkedTable(tbl, chunk_rows=2048),
+                               base=True, arrow=tbl)
+        else:
+            s.create_temp_view(name, tbl, base=True)
+    return s
+
+
+def reference(tables):
+    """Plain-width eager reference: resident tables, encoding OFF."""
+    with _env(NDS_TPU_ENCODED="0", NDS_TPU_PARAM_BIND="0"):
+        s = _make_session(tables, chunked=False)
+        return {t["name"]: [s.sql(t["sql"](v)).collect()
+                            for v in t["vectors"]]
+                for t in _TEMPLATES}
+
+
+def fresh_recording(tables):
+    """Per-value fresh recording: bind OFF, pipeline cache reset before
+    every vector — each parameter vector records and compiles its own
+    program (today's pre-bind behaviour, the lockstep baseline)."""
+    from nds_tpu.engine import stream as S
+    out = {}
+    with _env(NDS_TPU_PARAM_BIND="0", NDS_TPU_STREAM_STRICT="1"):
+        s = _make_session(tables, chunked=True)
+        for t in _TEMPLATES:
+            rows = []
+            for v in t["vectors"]:
+                S.reset_pipeline_cache()
+                rows.append(s.sql(t["sql"](v)).collect())
+            out[t["name"]] = rows
+    return out
+
+
+def run_bind_arm(name, env_kv, tables):
+    """One bind-mode arm: per template, run every vector against ONE
+    warm session, recording results, distinct compiled shapes, total
+    compiles, cache hit/miss deltas and stream-event paths."""
+    from nds_tpu.engine import stream as S
+    from nds_tpu.listener import drain_stream_events
+    from nds_tpu.obs import metrics as M
+    out = {"name": name, "templates": {}}
+    with _env(NDS_TPU_STREAM_STRICT="1", **env_kv):
+        s = _make_session(tables, chunked=True)
+        for t in _TEMPLATES:
+            S.reset_pipeline_cache()
+            reg = M.default()
+            h0 = reg.counter(M.PIPE_HIT)
+            m0 = reg.counter(M.PIPE_MISS)
+            drain_stream_events()
+            rows, paths = [], []
+            for v in t["vectors"]:
+                rows.append(s.sql(t["sql"](v)).collect())
+                paths.extend(e.path for e in drain_stream_events())
+            counts = S.pipeline_build_counts()
+            out["templates"][t["name"]] = {
+                "rows": rows, "paths": paths,
+                "n_keys": len(counts), "n_builds": sum(counts.values()),
+                "hits": reg.counter(M.PIPE_HIT) - h0,
+                "misses": reg.counter(M.PIPE_MISS) - m0,
+            }
+    return out
+
+
+def static_reports():
+    """ParamAuditor lockstep half: one report per template statement."""
+    from nds_tpu.analysis.param_audit import ParamAuditor
+    auditor = ParamAuditor()
+    return {t["name"]: auditor.audit_sql(t["sql"](t["vectors"][0]),
+                                         file="param_audit_diff",
+                                         query=t["name"])
+            for t in _TEMPLATES}
+
+
+def compare(expect, fresh, arm, reports, lines=None, drift=False):
+    """All harness assertions for one bind arm. Returns (ok, lines)."""
+    ok = True
+    lines = [] if lines is None else lines
+    K = len(_TEMPLATES[0]["vectors"])
+    for t in _TEMPLATES:
+        got = arm["templates"][t["name"]]
+        tag = f"{t['name']} [{arm['name']}]"
+        if any(p != "compiled" for p in got["paths"]) or \
+                len(got["paths"]) < K:
+            ok = False
+            lines.append(f"MISMATCH: {tag} not every vector took the "
+                         f"compiled stream path: {got['paths']}")
+            continue
+        # bit-for-bit: bound operands vs per-value fresh recording AND
+        # the plain-width eager reference
+        for i, v in enumerate(t["vectors"]):
+            if got["rows"][i] != fresh[t["name"]][i] or \
+                    got["rows"][i] != expect[t["name"]][i]:
+                ok = False
+                lines.append(f"MISMATCH: {tag} vector {v} diverges "
+                             "from per-value fresh recording")
+            else:
+                lines.append(f"ok: {tag} vector {v} bit-identical "
+                             "to fresh recording + eager reference")
+        rep = reports[t["name"]]
+        if t["bindable"]:
+            # THE tentpole claim: one compile serves all K vectors
+            if got["n_keys"] != 1 or got["n_builds"] != 1:
+                ok = False
+                lines.append(f"MISMATCH: {tag} expected ONE compiled "
+                             f"shape for {K} vectors, got "
+                             f"{got['n_keys']} keys / "
+                             f"{got['n_builds']} builds")
+            else:
+                lines.append(f"ok: {tag} ONE compile served {K} "
+                             "parameter vectors")
+            if got["misses"] != 1 or got["hits"] != K - 1:
+                ok = False
+                lines.append(f"MISMATCH: {tag} cache counters "
+                             f"{got['misses']} miss/{got['hits']} hit, "
+                             f"expected 1/{K - 1}")
+            if rep.n_bindable != t["slots"]:
+                ok = False
+                lines.append(f"MISMATCH: {tag} static signature has "
+                             f"{rep.n_bindable} slots, runtime bound "
+                             f"{t['slots']}")
+            else:
+                lines.append(f"ok: {tag} static signature "
+                             f"[{rep.signature()}] matches the "
+                             f"{t['slots']} runtime slots")
+        else:
+            # negative direction: FOLD-REQUIRED slots change the key
+            if got["n_keys"] != K:
+                ok = False
+                lines.append(f"MISMATCH: {tag} fold-required template "
+                             f"expected {K} distinct cache keys, got "
+                             f"{got['n_keys']} (a fold slot stopped "
+                             "changing the key)")
+            else:
+                lines.append(f"ok: {tag} fold-required slots changed "
+                             f"the key ({K} shapes for {K} vectors)")
+            if not drift and rep.n_bindable != 0:
+                ok = False
+                lines.append(f"MISMATCH: {tag} static signature claims "
+                             f"{rep.n_bindable} bindable slots on a "
+                             "fold-required template")
+    return ok, lines
+
+
+_SHARED: dict = {}
+
+
+def _shared_state():
+    """tables + both references are bind-OFF computations identical in
+    normal and inject mode (drift only flips the bindability rule), so
+    an in-process caller driving run_diff twice shares one recording."""
+    if not _SHARED:
+        import numpy as np
+        tables = _toy_tables(np.random.default_rng(20260117))
+        _SHARED["state"] = (tables, reference(tables),
+                            fresh_recording(tables))
+    return _SHARED["state"]
+
+
+def run_diff(inject_drift=False):
+    """Full harness. Normal mode: (ok, lines). Inject mode: drifts the
+    shared rule and succeeds only when BOTH directions are rejected."""
+    tables, expect, fresh = _shared_state()
+    reports = static_reports()
+
+    if not inject_drift:
+        lines = []
+        ok = True
+        for name, env_kv in _ARMS:
+            if name == "sharded":
+                import jax
+                if jax.device_count() < 2:
+                    lines.append("# sharded arm skipped: no multi-"
+                                 "device mesh")
+                    continue
+            arm = run_bind_arm(name, env_kv, tables)
+            aok, lines = compare(expect, fresh, arm, reports, lines)
+            ok = ok and aok
+        return ok, lines
+
+    # inject mode: NDS_TPU_PARAM_DRIFT=1 makes the shared rule treat
+    # IN-list members as bindable comparands (analysis + runtime drift
+    # together — exactly what a real classification bug looks like)
+    with _env(NDS_TPU_PARAM_DRIFT="1"):
+        drift_arm = run_bind_arm("base+drift", {}, tables)
+        drift_reports = static_reports()
+    ok_d, lines_d = compare(expect, fresh, drift_arm, drift_reports,
+                            drift=True)
+    fold = drift_arm["templates"]["fold-inlist"]
+    # direction A — wrong results: the drifted slot binds, the key
+    # collapses, but _eval_in_list bakes values on host, so a cache hit
+    # serves the FIRST vector's membership test
+    rejected_a = any("diverges" in ln and "fold-inlist" in ln
+                     for ln in lines_d)
+    # direction B — key variance: the fold-required K-distinct-keys
+    # assertion must fire (the drifted slot stopped changing the key)
+    rejected_b = fold["n_keys"] != len(_TEMPLATES[0]["vectors"]) and \
+        any("stopped changing the key" in ln for ln in lines_d)
+    lines = [
+        "inject-drift A (bound fold slot serves baked in-list values): "
+        + ("correctly rejected" if rejected_a else "NOT DETECTED"),
+        "inject-drift B (fold slot stopped changing the cache key): "
+        + ("correctly rejected" if rejected_b else "NOT DETECTED"),
+    ]
+    return rejected_a and rejected_b, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--inject-drift", action="store_true",
+                    help="self-test: misclassify IN-list members as "
+                         "bindable (NDS_TPU_PARAM_DRIFT=1) — the "
+                         "harness must reject both the wrong-results "
+                         "and the key-variance direction")
+    args = ap.parse_args(argv)
+    ok, lines = run_diff(inject_drift=args.inject_drift)
+    print("\n".join(lines))
+    if args.inject_drift:
+        print("inject-drift: both directions rejected" if ok
+              else "inject-drift: a drifted binding survived")
+        return 0 if ok else 1
+    print("param-audit-diff: one compile served every parameter vector "
+          "bit-for-bit" if ok else "param-audit-diff: DRIFT")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
